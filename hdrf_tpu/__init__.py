@@ -1,0 +1,24 @@
+"""hdrf_tpu — a TPU-native distributed file system with transparent data reduction.
+
+Built from scratch with the capability surface of NSWRyan/HDRF (an Apache Hadoop
+HDFS 3.1.0 fork that performs content-defined-chunking deduplication and block
+compression inside the DataNode write/read path). See ARCHITECTURE.md for the
+component map and SURVEY.md for the reference analysis.
+
+Subpackages:
+    config     -- real configuration system (replaces DataNode.java:412-458 statics)
+    native     -- ctypes bindings to libhdrf_native.so (C++ SHA-256/LZ4/CDC/CRC32C)
+    ops        -- JAX/Pallas TPU kernels: CDC candidate scan, SHA-256 fingerprints
+    parallel   -- multi-chip sharded reduction over jax.sharding.Mesh
+    reduction  -- ReductionScheme plugin registry + schemes
+    index      -- durable chunk/fingerprint index (replaces Redis)
+    storage    -- replica dataset + chunk container store
+    proto      -- wire protocol framing (control RPC + data transfer)
+    server     -- namenode (metadata plane) + datanode (data plane)
+    client     -- DFS client (put/get, write pipeline, read failover)
+    tools      -- CLI
+    utils      -- metrics, tracing, fault injection
+    testing    -- MiniCluster in-process fixture, simulated dataset
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,200 @@
+"""Configuration system.
+
+Replaces the reference's compile-time statics (DataNode.java:412-458: ``modrun``,
+``compressor``, ``hasher``, ``maxSize``, ``nRead``/``nWrite``, ``chunkDir``) and its
+untouched Hadoop ``Configuration``/``hdfs-default.xml`` machinery with one typed,
+layered config: defaults -> TOML file -> environment -> explicit overrides.
+
+Key registry mirrors DFSConfigKeys.java / HdfsClientConfigKeys.java in spirit:
+every tunable has a dotted key, a type, and a default, and is discoverable via
+:func:`default_config`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any
+
+ENV_PREFIX = "HDRF_"
+
+
+@dataclass
+class CdcConfig:
+    """Content-defined chunking parameters.
+
+    Reference fixed these at DataDeduplicator.java:264-307 (local-max window 700 B,
+    max chunk 1 MB); BASELINE config 3 also exercises a window=48 / avg-8KB variant.
+    """
+
+    # Gear-hash boundary mask: boundary candidate when (hash & mask) == 0.
+    # mask_bits=13 -> average chunk ~8 KiB.
+    mask_bits: int = 13
+    min_chunk: int = 2048
+    max_chunk: int = 65536
+    # Normalization: FastCDC-style two-mask scheme (stricter mask before the
+    # average point, looser after) reduces chunk-size variance.
+    normalized: bool = True
+
+    @property
+    def avg_chunk(self) -> int:
+        return 1 << self.mask_bits
+
+
+@dataclass
+class ReductionConfig:
+    """Reduction pipeline selection + resources.
+
+    Replaces DataNode.java:438 ``compressor`` hardcoded switch and the per-scheme
+    concurrency table at DataNode.java:499-510.
+    """
+
+    # Default scheme name for new files; overridable per-create by client policy.
+    default_scheme: str = "dedup_lz4"
+    # Max concurrent reduction jobs per datanode (admission control; replaces the
+    # ticket queues at DataXceiver.java:313-380).
+    max_concurrent_writes: int = 4
+    max_concurrent_reads: int = 8
+    # Chunk container rollover size (reference: 2**25 at DataNode.java:434).
+    container_size: int = 1 << 25
+    # Compress containers on rollover (reference: LZ4 at DataDeduplicator.java:770-781).
+    container_codec: str = "lz4"
+    # Execution backend for the per-byte scans: "native" (C++), "tpu" (JAX/Pallas),
+    # or "auto" (tpu when an accelerator is present).
+    backend: str = "auto"
+    cdc: CdcConfig = field(default_factory=CdcConfig)
+
+
+@dataclass
+class NameNodeConfig:
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = ephemeral
+    # Namespace persistence (FSImage.java:85 + FSEditLog.java:124 equivalents).
+    meta_dir: str = "/tmp/hdrf/name"
+    # Default replication factor & block size (hdfs-default.xml equivalents).
+    replication: int = 3
+    block_size: int = 128 * 1024 * 1024
+    # Heartbeat bookkeeping (HeartbeatManager.java:44).
+    heartbeat_interval_s: float = 1.0
+    dead_node_interval_s: float = 6.0
+    editlog_checkpoint_every: int = 1000  # ops between auto-checkpoints
+
+
+@dataclass
+class DataNodeConfig:
+    host: str = "127.0.0.1"
+    port: int = 0
+    data_dir: str = "/tmp/hdrf/data"
+    # Packet size on the data-transfer wire (reference default 64 KB).
+    packet_size: int = 64 * 1024
+    heartbeat_interval_s: float = 1.0
+    block_report_interval_s: float = 30.0
+    reduction: ReductionConfig = field(default_factory=ReductionConfig)
+
+
+@dataclass
+class ClientConfig:
+    packet_size: int = 64 * 1024
+    # Outstanding un-acked packets in the write pipeline (DataStreamer window).
+    max_inflight_packets: int = 16
+    read_retries: int = 3
+
+
+@dataclass
+class HdrfConfig:
+    namenode: NameNodeConfig = field(default_factory=NameNodeConfig)
+    datanode: DataNodeConfig = field(default_factory=DataNodeConfig)
+    client: ClientConfig = field(default_factory=ClientConfig)
+
+    # ---- layered loading -------------------------------------------------
+
+    @staticmethod
+    def load(path: str | None = None, env: dict[str, str] | None = None,
+             overrides: dict[str, Any] | None = None) -> "HdrfConfig":
+        cfg = HdrfConfig()
+        if path:
+            with open(path, "rb") as f:  # explicit path must exist
+                cfg._apply_mapping(tomllib.load(f))
+        cfg._apply_env(os.environ if env is None else env)
+        if overrides:
+            for k, v in overrides.items():
+                cfg.set(k, v)
+        return cfg
+
+    def _apply_mapping(self, m: dict[str, Any], prefix: str = "") -> None:
+        for k, v in m.items():
+            key = f"{prefix}{k}" if not prefix else f"{prefix}.{k}"
+            if isinstance(v, dict):
+                self._apply_mapping(v, key)
+            else:
+                self.set(key, v)
+
+    def _apply_env(self, env: dict[str, str]) -> None:
+        # HDRF_DATANODE_REDUCTION_DEFAULT_SCHEME=zstd -> datanode.reduction.default_scheme
+        for name, raw in env.items():
+            if not name.startswith(ENV_PREFIX):
+                continue
+            key = name[len(ENV_PREFIX):].lower().replace("_", ".")
+            try:
+                self.set(key, _parse_scalar(raw))
+            except KeyError:
+                continue  # unknown env keys are ignored, like Hadoop's
+
+    def set(self, dotted_key: str, value: Any) -> None:
+        """Set a value by dotted key.
+
+        Env-style keys can't distinguish '.' from '_' (both arrive as '.'), so
+        matching greedily joins leading segments against field names:
+        ``datanode.reduction.default.scheme`` resolves to
+        ``datanode.reduction.default_scheme``.
+        """
+        _dotted_set(self, dotted_key.split("."), dotted_key, value)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _dotted_set(obj: Any, parts: list[str], full_key: str, value: Any) -> None:
+    fields = {f.name for f in dataclasses.fields(obj)}
+    for j in range(len(parts), 0, -1):
+        cand = "_".join(parts[:j])
+        if cand not in fields:
+            continue
+        cur = getattr(obj, cand)
+        if j == len(parts):
+            if dataclasses.is_dataclass(cur):
+                raise KeyError(f"{full_key!r} names a section, not a value")
+            setattr(obj, cand, _coerce(value, type(cur)))
+            return
+        if dataclasses.is_dataclass(cur):
+            return _dotted_set(cur, parts[j:], full_key, value)
+    raise KeyError(f"unknown config key: {full_key!r}")
+
+
+def _coerce(value: Any, typ: type | None) -> Any:
+    if typ is None or isinstance(value, typ):
+        return value
+    if typ is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if typ in (int, float, str):
+        return typ(value)
+    return value
+
+
+def _parse_scalar(raw: str) -> Any:
+    for conv in (int, float):
+        try:
+            return conv(raw)
+        except ValueError:
+            pass
+    if raw.lower() in ("true", "false"):
+        return raw.lower() == "true"
+    return raw
+
+
+def default_config() -> HdrfConfig:
+    return HdrfConfig()

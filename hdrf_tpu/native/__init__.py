@@ -1,0 +1,192 @@
+"""ctypes bindings to libhdrf_native.so.
+
+The native library plays the role of the reference's native layer:
+libnayuki-native-hashes.so (JNI SHA, utilities.java:98-137), JNI codec backends
+(snappy-java / hadoop-lzo), and the hot CDC scan loop
+(DataDeduplicator.chunking(), DataDeduplicator.java:264-307).
+
+Built on demand from ``src/*.cpp`` with g++ if the .so is missing or stale —
+the moral equivalent of the reference installing its prebuilt jar from
+``hadoop-hdfs/pom.xml:245-255``, but from source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libhdrf_native.so")
+
+_lib: ctypes.CDLL | None = None
+_lock = threading.Lock()
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+
+
+def _build() -> None:
+    subprocess.run(["make", "-s", "-C", _DIR], check=True,
+                   capture_output=True, text=True)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        srcs = [os.path.join(_DIR, "src", f) for f in os.listdir(os.path.join(_DIR, "src"))
+                if f.endswith(".cpp")]
+        if not os.path.exists(_SO) or any(os.path.getmtime(s) > os.path.getmtime(_SO)
+                                          for s in srcs):
+            _build()
+        lib = ctypes.CDLL(_SO)
+
+        lib.hdrf_sha256.argtypes = [_u8p, ctypes.c_uint64, _u8p]
+        lib.hdrf_sha256_batch.argtypes = [_u8p, _u64p, _u64p, ctypes.c_uint64, _u8p]
+        lib.hdrf_gear_table.argtypes = [_u32p]
+        lib.hdrf_gear_candidates.argtypes = [_u8p, ctypes.c_uint64, ctypes.c_uint32,
+                                             _u64p, ctypes.c_uint64]
+        lib.hdrf_gear_candidates.restype = ctypes.c_uint64
+        lib.hdrf_cdc_select.argtypes = [_u64p, ctypes.c_uint64, ctypes.c_uint64,
+                                        ctypes.c_uint64, ctypes.c_uint64, _u64p,
+                                        ctypes.c_uint64]
+        lib.hdrf_cdc_select.restype = ctypes.c_uint64
+        lib.hdrf_cdc_chunk.argtypes = [_u8p, ctypes.c_uint64, ctypes.c_uint32,
+                                       ctypes.c_uint64, ctypes.c_uint64, _u64p,
+                                       ctypes.c_uint64]
+        lib.hdrf_cdc_chunk.restype = ctypes.c_uint64
+        lib.hdrf_lz4_compress_bound.argtypes = [ctypes.c_uint64]
+        lib.hdrf_lz4_compress_bound.restype = ctypes.c_uint64
+        lib.hdrf_lz4_compress.argtypes = [_u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64]
+        lib.hdrf_lz4_compress.restype = ctypes.c_uint64
+        lib.hdrf_lz4_decompress.argtypes = [_u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64]
+        lib.hdrf_lz4_decompress.restype = ctypes.c_uint64
+        lib.hdrf_crc32c.argtypes = [ctypes.c_uint32, _u8p, ctypes.c_uint64]
+        lib.hdrf_crc32c.restype = ctypes.c_uint32
+        lib.hdrf_crc32c_chunks.argtypes = [_u8p, ctypes.c_uint64, ctypes.c_uint64, _u32p]
+        _lib = lib
+        return lib
+
+
+def _as_u8(buf: bytes | bytearray | memoryview | np.ndarray) -> np.ndarray:
+    if isinstance(buf, np.ndarray):
+        if buf.dtype != np.uint8 or not buf.flags.c_contiguous:
+            raise ValueError("expected C-contiguous uint8 array")
+        return buf
+    return np.frombuffer(buf, dtype=np.uint8)
+
+
+def _ptr(a: np.ndarray, typ):  # noqa: ANN001
+    return a.ctypes.data_as(typ)
+
+
+# ---------------------------------------------------------------- public API
+
+
+def sha256(data: bytes | np.ndarray) -> bytes:
+    a = _as_u8(data)
+    out = np.empty(32, dtype=np.uint8)
+    _load().hdrf_sha256(_ptr(a, _u8p), a.size, _ptr(out, _u8p))
+    return out.tobytes()
+
+
+def sha256_batch(data: bytes | np.ndarray, offsets: np.ndarray,
+                 lengths: np.ndarray) -> np.ndarray:
+    """Hash n sub-ranges of `data`; returns (n, 32) uint8 digests."""
+    a = _as_u8(data)
+    offs = np.ascontiguousarray(offsets, dtype=np.uint64)
+    lens = np.ascontiguousarray(lengths, dtype=np.uint64)
+    if offs.shape != lens.shape:
+        raise ValueError("offsets/lengths shape mismatch")
+    if offs.size and int((offs + lens).max()) > a.size:
+        raise ValueError("chunk range exceeds data buffer")
+    n = offs.size
+    out = np.empty((n, 32), dtype=np.uint8)
+    _load().hdrf_sha256_batch(_ptr(a, _u8p), _ptr(offs, _u64p), _ptr(lens, _u64p),
+                              n, _ptr(out, _u8p))
+    return out
+
+
+def gear_table() -> np.ndarray:
+    out = np.empty(256, dtype=np.uint32)
+    _load().hdrf_gear_table(_ptr(out, _u32p))
+    return out
+
+
+def gear_candidates(data: bytes | np.ndarray, mask: int) -> np.ndarray:
+    a = _as_u8(data)
+    cap = max(a.size // 8, 1024)
+    out = np.empty(cap, dtype=np.uint64)
+    n = _load().hdrf_gear_candidates(_ptr(a, _u8p), a.size, mask & 0xFFFFFFFF,
+                                     _ptr(out, _u64p), cap)
+    if n > cap:  # dense-candidate mask (few effective bits): retry exact-sized
+        out = np.empty(n, dtype=np.uint64)
+        n = _load().hdrf_gear_candidates(_ptr(a, _u8p), a.size, mask & 0xFFFFFFFF,
+                                         _ptr(out, _u64p), n)
+    return out[:n].copy()
+
+
+def cdc_select(candidates: np.ndarray, length: int, min_chunk: int,
+               max_chunk: int) -> np.ndarray:
+    cand = np.ascontiguousarray(candidates, dtype=np.uint64)
+    cap = length // max(min_chunk, 1) + 2
+    out = np.empty(cap, dtype=np.uint64)
+    n = _load().hdrf_cdc_select(_ptr(cand, _u64p), cand.size, length, min_chunk,
+                                max_chunk, _ptr(out, _u64p), cap)
+    return out[:n].copy()
+
+
+def cdc_chunk(data: bytes | np.ndarray, mask: int, min_chunk: int,
+              max_chunk: int) -> np.ndarray:
+    """Sequential CPU chunker: cut-points (exclusive ends) for the whole buffer."""
+    a = _as_u8(data)
+    cap = a.size // max(min_chunk, 1) + 2
+    out = np.empty(cap, dtype=np.uint64)
+    n = _load().hdrf_cdc_chunk(_ptr(a, _u8p), a.size, mask & 0xFFFFFFFF, min_chunk,
+                               max_chunk, _ptr(out, _u64p), cap)
+    return out[:n].copy()
+
+
+def lz4_compress(data: bytes | np.ndarray) -> bytes:
+    a = _as_u8(data)
+    if a.size == 0:
+        return b""
+    cap = _load().hdrf_lz4_compress_bound(a.size)
+    out = np.empty(cap, dtype=np.uint8)
+    n = _load().hdrf_lz4_compress(_ptr(a, _u8p), a.size, _ptr(out, _u8p), cap)
+    if n == 0:
+        raise RuntimeError("lz4 compression failed")
+    return out[:n].tobytes()
+
+
+def lz4_decompress(data: bytes | np.ndarray, decompressed_size: int) -> bytes:
+    a = _as_u8(data)
+    if decompressed_size == 0:
+        return b""
+    out = np.empty(decompressed_size, dtype=np.uint8)
+    n = _load().hdrf_lz4_decompress(_ptr(a, _u8p), a.size, _ptr(out, _u8p),
+                                    decompressed_size)
+    if n != decompressed_size:
+        raise RuntimeError(f"lz4 decompression failed: got {n}, want {decompressed_size}")
+    return out.tobytes()
+
+
+def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:
+    a = _as_u8(data)
+    return _load().hdrf_crc32c(crc & 0xFFFFFFFF, _ptr(a, _u8p), a.size)
+
+
+def crc32c_chunks(data: bytes | np.ndarray, chunk_size: int) -> np.ndarray:
+    a = _as_u8(data)
+    n = (a.size + chunk_size - 1) // chunk_size
+    out = np.empty(max(n, 1), dtype=np.uint32)
+    _load().hdrf_crc32c_chunks(_ptr(a, _u8p), a.size, chunk_size, _ptr(out, _u32p))
+    return out[:n]

@@ -1,0 +1,117 @@
+// Content-defined chunking (Gear rolling hash) — native CPU backend.
+//
+// Re-designs the reference's local-maximum CDC (DataDeduplicator.java:264-307,
+// window 700 B, max chunk 1 MB) as Gear-hash CDC: h = (h << 1) + G[byte], with a
+// cut-point candidate wherever (h & mask) == 0. Because `h << 1` discards a byte's
+// contribution after 32 shifts, the hash at position p is a pure function of the
+// trailing 32 bytes — making the candidate set position-independent and therefore
+// (a) content-defined under insertions/deletions and (b) computable in parallel,
+// which is what lets the same algorithm run as a TPU kernel (ops/cdc.py).
+//
+// The boundary *selection* (enforce min/max chunk) is inherently sequential but
+// touches only the sparse candidate list (~len/2^mask_bits entries).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// Deterministic gear table shared with the JAX implementation (ops/cdc.py):
+// splitmix64 stream seeded with 0x9E3779B97F4A7C15, low 32 bits of each output.
+uint64_t splitmix64(uint64_t &s) {
+  uint64_t z = (s += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+struct GearTable {
+  uint32_t g[256];
+  GearTable() {
+    uint64_t s = 0x9E3779B97F4A7C15ULL;
+    for (int i = 0; i < 256; i++) g[i] = uint32_t(splitmix64(s));
+  }
+};
+const GearTable GT;
+
+}  // namespace
+
+extern "C" {
+
+// Expose the gear table so Python/JAX builds bit-identical copies.
+void hdrf_gear_table(uint32_t out[256]) { memcpy(out, GT.g, sizeof(GT.g)); }
+
+// All candidate cut-points: p in [32, len] such that the gear hash of bytes
+// [p-32, p) satisfies (h & mask) == 0. Cut-point p means "chunk may end before
+// byte p". Returns the TOTAL number of candidates found (may exceed cap; only
+// the first cap are written — callers detect overflow and retry with a larger
+// buffer).
+uint64_t hdrf_gear_candidates(const uint8_t *data, uint64_t len, uint32_t mask,
+                              uint64_t *out_pos, uint64_t cap) {
+  uint32_t h = 0;
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < len; i++) {
+    h = (h << 1) + GT.g[data[i]];
+    if (i + 1 >= 32 && (h & mask) == 0) {
+      if (n < cap) out_pos[n] = i + 1;
+      n++;
+    }
+  }
+  return n;
+}
+
+// Select chunk boundaries from a sorted candidate list, enforcing min/max chunk
+// sizes. Shared by the CPU and TPU paths (the TPU kernel emits candidates; this
+// resolves them). Rule per chunk starting at `start`:
+//   lo = start + min_chunk, hi = min(start + max_chunk, len)
+//   cut = first candidate in [lo, hi], else hi.
+// Writes cut-points (exclusive chunk ends); the final cut is always `len`.
+// Requires min_chunk >= 1 (guarantees progress). Returns number of cuts.
+uint64_t hdrf_cdc_select(const uint64_t *cand, uint64_t ncand, uint64_t len,
+                         uint64_t min_chunk, uint64_t max_chunk,
+                         uint64_t *out_cuts, uint64_t cap) {
+  uint64_t start = 0, n = 0, ci = 0;
+  if (min_chunk == 0) min_chunk = 1;
+  while (start < len && n < cap) {
+    uint64_t lo = start + min_chunk;
+    uint64_t hi = start + max_chunk;
+    if (hi > len) hi = len;
+    while (ci < ncand && cand[ci] < lo) ci++;
+    uint64_t cut = (ci < ncand && cand[ci] <= hi) ? cand[ci] : hi;
+    out_cuts[n++] = cut;
+    start = cut;
+  }
+  return n;
+}
+
+// One-call sequential chunker (candidates + selection fused): the CPU baseline
+// the >=4x TPU target is measured against, and the correctness oracle for the
+// two-phase path. Bit-identical output to
+// hdrf_gear_candidates(mask) + hdrf_cdc_select(min,max).
+uint64_t hdrf_cdc_chunk(const uint8_t *data, uint64_t len, uint32_t mask,
+                        uint64_t min_chunk, uint64_t max_chunk,
+                        uint64_t *out_cuts, uint64_t cap) {
+  uint64_t start = 0, n = 0;
+  if (min_chunk == 0) min_chunk = 1;
+  while (start < len && n < cap) {
+    uint64_t lo = start + min_chunk;
+    uint64_t hi = start + max_chunk;
+    if (hi > len) hi = len;
+    uint64_t cut = hi;
+    if (lo <= hi) {
+      // Gear hash at cut-point p covers bytes [p-32, p); warm up from lo-32.
+      uint64_t warm = lo >= 32 ? lo - 32 : 0;
+      uint32_t h = 0;
+      for (uint64_t i = warm; i < lo && i < len; i++) h = (h << 1) + GT.g[data[i]];
+      for (uint64_t p = lo; p <= hi; p++) {
+        if (p >= 32 && (h & mask) == 0) { cut = p; break; }
+        if (p < hi) h = (h << 1) + GT.g[data[p]];
+      }
+    }
+    out_cuts[n++] = cut;
+    start = cut;
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,164 @@
+// LZ4 block-format codec, implemented from scratch.
+//
+// Role-equivalent of the reference's JNI codec backends (snappy-java / hadoop-lzo /
+// Hadoop Lz4 reached from BlockReceiver.java:822-866 and the container rollover
+// compression at DataDeduplicator.java:770-781). Standard LZ4 block format:
+// sequences of [token][lit-len ext*][literals][offset u16le][match-len ext*],
+// minimum match 4, last sequence is literals-only.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int MIN_MATCH = 4;
+constexpr int HASH_LOG = 16;
+constexpr int LAST_LITERALS = 5;   // spec: last 5 bytes are always literals
+constexpr int MFLIMIT = 12;        // spec: no match may start within last 12 bytes
+
+inline uint32_t read32(const uint8_t *p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t hash4(uint32_t v) {
+  return (v * 2654435761u) >> (32 - HASH_LOG);
+}
+
+// Write a length with 255-run extension bytes.
+inline uint8_t *write_len_ext(uint8_t *op, uint64_t len) {
+  while (len >= 255) { *op++ = 255; len -= 255; }
+  *op++ = uint8_t(len);
+  return op;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t hdrf_lz4_compress_bound(uint64_t n) { return n + n / 255 + 16; }
+
+// Returns compressed size, or 0 if dst is too small / input empty.
+uint64_t hdrf_lz4_compress(const uint8_t *src, uint64_t srclen, uint8_t *dst,
+                           uint64_t dstcap) {
+  if (srclen == 0 || dstcap < hdrf_lz4_compress_bound(srclen)) return 0;
+  static thread_local uint32_t table[1 << HASH_LOG];
+  memset(table, 0, sizeof(table));
+
+  const uint8_t *ip = src;
+  const uint8_t *anchor = src;
+  const uint8_t *iend = src + srclen;
+  const uint8_t *mflimit = srclen > MFLIMIT ? iend - MFLIMIT : src;
+  uint8_t *op = dst;
+
+  if (srclen > MFLIMIT) {
+    table[hash4(read32(ip))] = 0;
+    ip++;
+    while (ip < mflimit) {
+      // Find a match via the 4-byte hash table.
+      uint32_t h = hash4(read32(ip));
+      const uint8_t *ref = src + table[h];
+      table[h] = uint32_t(ip - src);
+      if (ref >= ip || ip - ref > 65535 || read32(ref) != read32(ip)) {
+        ip++;
+        continue;
+      }
+      // Extend the match backward over pending literals.
+      while (ip > anchor && ref > src && ip[-1] == ref[-1]) { ip--; ref--; }
+      // Extend forward (must leave LAST_LITERALS at the tail).
+      const uint8_t *matchlimit = iend - LAST_LITERALS;
+      const uint8_t *mip = ip + MIN_MATCH;
+      const uint8_t *mref = ref + MIN_MATCH;
+      while (mip < matchlimit && *mip == *mref) { mip++; mref++; }
+      uint64_t matchlen = uint64_t(mip - ip);
+      uint64_t litlen = uint64_t(ip - anchor);
+
+      // Token + literal run.
+      uint8_t *token = op++;
+      if (litlen >= 15) {
+        *token = 0xF0;
+        op = write_len_ext(op, litlen - 15);
+      } else {
+        *token = uint8_t(litlen << 4);
+      }
+      memcpy(op, anchor, litlen);
+      op += litlen;
+      // Offset + match length.
+      uint16_t off = uint16_t(ip - ref);
+      *op++ = uint8_t(off);
+      *op++ = uint8_t(off >> 8);
+      uint64_t mlcode = matchlen - MIN_MATCH;
+      if (mlcode >= 15) {
+        *token |= 0x0F;
+        op = write_len_ext(op, mlcode - 15);
+      } else {
+        *token |= uint8_t(mlcode);
+      }
+      ip = mip;
+      anchor = ip;
+      if (ip < mflimit) table[hash4(read32(ip))] = uint32_t(ip - src);
+    }
+  }
+
+  // Final literals-only sequence.
+  uint64_t litlen = uint64_t(iend - anchor);
+  uint8_t *token = op++;
+  if (litlen >= 15) {
+    *token = 0xF0;
+    op = write_len_ext(op, litlen - 15);
+  } else {
+    *token = uint8_t(litlen << 4);
+  }
+  memcpy(op, anchor, litlen);
+  op += litlen;
+  return uint64_t(op - dst);
+}
+
+// Returns decompressed size, or 0 on malformed input / overflow.
+uint64_t hdrf_lz4_decompress(const uint8_t *src, uint64_t srclen, uint8_t *dst,
+                             uint64_t dstcap) {
+  const uint8_t *ip = src, *iend = src + srclen;
+  uint8_t *op = dst, *oend = dst + dstcap;
+  while (ip < iend) {
+    uint8_t token = *ip++;
+    // Literals.
+    uint64_t litlen = token >> 4;
+    if (litlen == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return 0;
+        b = *ip++;
+        litlen += b;
+      } while (b == 255);
+    }
+    if (uint64_t(iend - ip) < litlen || uint64_t(oend - op) < litlen) return 0;
+    memcpy(op, ip, litlen);
+    ip += litlen;
+    op += litlen;
+    if (ip == iend) break;  // last sequence has no match part
+    // Match.
+    if (iend - ip < 2) return 0;
+    uint32_t offset = uint32_t(ip[0]) | (uint32_t(ip[1]) << 8);
+    ip += 2;
+    if (offset == 0 || offset > uint64_t(op - dst)) return 0;
+    uint64_t matchlen = (token & 0x0F);
+    if (matchlen == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return 0;
+        b = *ip++;
+        matchlen += b;
+      } while (b == 255);
+    }
+    matchlen += MIN_MATCH;
+    if (uint64_t(oend - op) < matchlen) return 0;
+    const uint8_t *match = op - offset;
+    // Byte-wise copy: offsets < matchlen intentionally replicate (RLE).
+    for (uint64_t i = 0; i < matchlen; i++) op[i] = match[i];
+    op += matchlen;
+  }
+  return uint64_t(op - dst);
+}
+
+}  // extern "C"

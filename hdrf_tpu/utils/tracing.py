@@ -1,0 +1,103 @@
+"""Lightweight distributed tracing.
+
+Equivalent of the reference's HTrace integration: each daemon owns a Tracer
+(DataNode.java:402-407), spans ride data-transfer op headers and are resumed
+server-side (Receiver.java:94-98 ``continueTraceSpan``). Here a span is
+``(trace_id, span_id, parent_id, name, t0, t1)``; the wire carries
+``(trace_id, span_id)`` in op headers, and finished spans accumulate in a
+bounded in-memory sink queryable from the HTTP status endpoint.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import struct
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+import contextlib
+
+
+def _rand_id() -> int:
+    return struct.unpack("<Q", os.urandom(8))[0] | 1
+
+
+@dataclass
+class Span:
+    trace_id: int
+    span_id: int
+    parent_id: int
+    name: str
+    tracer: "Tracer | None" = None
+    t0: float = field(default_factory=time.time)
+    t1: float | None = None
+    annotations: dict[str, Any] = field(default_factory=dict)
+
+    def context(self) -> tuple[int, int]:
+        """The bits that ride the wire (op header), cf. continueTraceSpan."""
+        return (self.trace_id, self.span_id)
+
+    def annotate(self, key: str, value: Any) -> None:
+        self.annotations[key] = value
+
+    def finish(self) -> None:
+        self.t1 = time.time()
+        if self.tracer is not None:
+            self.tracer._record(self)
+
+
+_current_span: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
+    "hdrf_current_span", default=None)
+
+
+class Tracer:
+    def __init__(self, name: str, max_spans: int = 4096) -> None:
+        self.name = name
+        self._sink: deque[Span] = deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: tuple[int, int] | None = None) -> Iterator[Span]:
+        """Open a span; ``parent`` is a wire context from an op header, if any."""
+        cur = _current_span.get()
+        if parent is not None:
+            trace_id, parent_id = parent
+        elif cur is not None:
+            trace_id, parent_id = cur.trace_id, cur.span_id
+        else:
+            trace_id, parent_id = _rand_id(), 0
+        sp = Span(trace_id, _rand_id(), parent_id, name, tracer=self)
+        token = _current_span.set(sp)
+        try:
+            yield sp
+        finally:
+            _current_span.reset(token)
+            sp.finish()
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._sink.append(span)
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._sink)
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        return [
+            {
+                "trace_id": f"{s.trace_id:016x}", "span_id": f"{s.span_id:016x}",
+                "parent_id": f"{s.parent_id:016x}", "name": s.name,
+                "start": s.t0, "duration_ms": None if s.t1 is None else (s.t1 - s.t0) * 1e3,
+                "annotations": s.annotations,
+            }
+            for s in self.spans()
+        ]
+
+
+def current_context() -> tuple[int, int] | None:
+    """Wire context of the active span, to stamp into outgoing op headers."""
+    sp = _current_span.get()
+    return None if sp is None else sp.context()

@@ -1,0 +1,25 @@
+"""Test env: force JAX onto an 8-device virtual CPU mesh before jax imports.
+
+Sharding tests (tests/test_sharding.py) exercise real Mesh/shard_map code paths on
+these virtual devices, mirroring how the driver's dryrun validates multi-chip
+compilation without real chips.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_injection():
+    yield
+    from hdrf_tpu.utils import fault_injection
+    fault_injection.clear()
