@@ -16,20 +16,23 @@
 
 namespace {
 
-// Deterministic gear table shared with the JAX implementation (ops/cdc.py):
-// splitmix64 stream seeded with 0x9E3779B97F4A7C15, low 32 bits of each output.
-uint64_t splitmix64(uint64_t &s) {
-  uint64_t z = (s += 0x9E3779B97F4A7C15ULL);
-  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
-  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
-  return z ^ (z >> 31);
+// Deterministic gear function shared with the JAX implementation (ops/gear.py):
+// G[b] = fmix32(b * 0x9E3779B1) (murmur3 finalizer). Chosen to be *arithmetic*
+// so the TPU side computes it with 6 elementwise VPU ops instead of a 256-entry
+// gather (which scalarizes on TPU); the CPU side pre-tabulates it.
+uint32_t fmix32(uint32_t z) {
+  z ^= z >> 16;
+  z *= 0x85EBCA6Bu;
+  z ^= z >> 13;
+  z *= 0xC2B2AE35u;
+  z ^= z >> 16;
+  return z;
 }
 
 struct GearTable {
   uint32_t g[256];
   GearTable() {
-    uint64_t s = 0x9E3779B97F4A7C15ULL;
-    for (int i = 0; i < 256; i++) g[i] = uint32_t(splitmix64(s));
+    for (int i = 0; i < 256; i++) g[i] = fmix32(uint32_t(i) * 0x9E3779B1u);
   }
 };
 const GearTable GT;

@@ -1,0 +1,69 @@
+"""JAX op tests: gear scan + SHA-256 lanes vs the native C++ oracles.
+
+Runs on the 8-device virtual CPU backend (conftest.py); the same code paths
+compile for TPU.
+"""
+
+import hashlib
+
+import numpy as np
+
+from hdrf_tpu import native
+from hdrf_tpu.ops import gear, sha256 as jsha
+
+RNG = np.random.default_rng(11)
+
+
+def test_gear_table_matches_native():
+    assert np.array_equal(gear.gear_table_np(), native.gear_table())
+
+
+def test_gear_candidates_match_native():
+    for n in [0, 31, 32, 100, 4096, 1 << 17]:
+        data = RNG.integers(0, 256, n, dtype=np.uint8)
+        mask = 0x3F0  # ~6 bits -> dense-ish
+        got = gear.gear_candidates_jax(data, mask)
+        want = native.gear_candidates(data, mask)
+        assert np.array_equal(got, want), n
+
+
+def test_gear_candidates_dense_mask():
+    data = RNG.integers(0, 256, 8192, dtype=np.uint8)
+    got = gear.gear_candidates_jax(data, 0x0)  # every position >= 32 matches
+    want = native.gear_candidates(data, 0x0)
+    assert np.array_equal(got, want)
+
+
+def test_cdc_chunk_jax_equals_native():
+    for n in [0, 5000, 1 << 18]:
+        data = RNG.integers(0, 256, n, dtype=np.uint8)
+        got = gear.cdc_chunk_jax(data, 0x1FF, 512, 8192)
+        want = native.cdc_chunk(data, 0x1FF, 512, 8192)
+        assert np.array_equal(got, want), n
+
+
+def test_sha256_lanes_vs_hashlib():
+    # Lengths straddling every padding edge case.
+    lengths = [1, 54, 55, 56, 63, 64, 65, 119, 120, 128, 1000]
+    data = RNG.integers(0, 256, sum(lengths), dtype=np.uint8)
+    cuts = np.cumsum(lengths).astype(np.uint64)
+    got = jsha.fingerprint_chunks(data, cuts)
+    off = 0
+    for i, ln in enumerate(lengths):
+        want = hashlib.sha256(data[off:off + ln].tobytes()).digest()
+        assert got[i].tobytes() == want, (i, ln)
+        off += ln
+
+
+def test_fingerprint_chunks_vs_native_batch():
+    data = RNG.integers(0, 256, 1 << 18, dtype=np.uint8)
+    cuts = native.cdc_chunk(data, 0x1FFF, 2048, 65536)
+    got = jsha.fingerprint_chunks(data, cuts)
+    offs = np.concatenate([[0], cuts[:-1]])
+    lens = cuts - offs
+    want = native.sha256_batch(data, offs, lens)
+    assert np.array_equal(got, want)
+
+
+def test_fingerprint_empty():
+    assert jsha.fingerprint_chunks(b"", np.array([], dtype=np.uint64)).shape == (0, 32)
