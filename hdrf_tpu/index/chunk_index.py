@@ -1,0 +1,310 @@
+"""Durable chunk/fingerprint index — the owned replacement for Redis.
+
+The reference keeps all reduction metadata in an external Redis at
+localhost:6379 with no auth, no durability guarantees, and no recovery path
+(SURVEY.md §5: "Redis or chunk-store loss = silent data loss"):
+
+- Table 1: 4-byte HDFS block ID -> [4-byte filesize | N x hash]
+  (DataDeduplicator.java:372-392, read back DataConstructor.java:91-100)
+- Table 2: hash -> 11-byte packed chunkMeta {nCopy, containerID, start, stop}
+  (chunkMeta.java:35-77, written DataDeduplicator.java:803)
+- per-block writer-thread container cursors (utilities.java:66-75)
+
+Here the same two tables are an in-process store with an append-only WAL,
+periodic checkpoints, and crash recovery = checkpoint + WAL replay.  Chunks are
+refcounted and deletable — the reference's "Table #3 for later"
+(DataDeduplicator.java:61-62) — so containers can be compacted.
+
+Durability discipline:
+
+- WAL record framing: [u32 payload_len][u32 crc32c(payload)][msgpack payload];
+  a torn final record (crash mid-append) is detected by CRC and dropped.
+- **Log before apply**: a failed WAL append leaves memory untouched, so later
+  records can never reference state the log doesn't contain.
+- **Sequence numbers make replay idempotent**: every record carries a
+  monotonically increasing seqno and the checkpoint stores the last seqno it
+  folded in; recovery skips WAL records <= that seqno, so a crash between
+  checkpoint publish and WAL truncation cannot double-apply refcounts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import threading
+from dataclasses import dataclass
+
+import msgpack
+
+from hdrf_tpu import native
+from hdrf_tpu.utils import fault_injection
+
+_HDR = struct.Struct("<II")
+
+WAL_NAME = "index.wal"
+CKPT_NAME = "index.ckpt"
+CKPT_TMP = "index.ckpt.tmp"
+
+
+@dataclass
+class ChunkLocation:
+    """Where a chunk's bytes live.  Fixed-width equivalent of the reference's
+    11-byte chunkMeta record (chunkMeta.java:35-60): container id, byte range
+    within the *uncompressed* container, and the refcount (nCopy)."""
+
+    container_id: int
+    offset: int
+    length: int
+    refcount: int = 1
+
+
+@dataclass
+class BlockEntry:
+    """Table-1 row: logical length + ordered chunk fingerprints."""
+
+    logical_len: int
+    hashes: list[bytes]
+
+
+class ChunkIndex:
+    """Thread-safe durable index with WAL + checkpoint recovery."""
+
+    def __init__(self, directory: str, checkpoint_every: int = 10000):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._blocks: dict[int, BlockEntry] = {}
+        self._chunks: dict[bytes, ChunkLocation] = {}
+        self._sealed: set[int] = set()  # container ids sealed (compressed)
+        self._seq = 0  # last seqno applied
+        self._ops_since_ckpt = 0
+        self._checkpoint_every = checkpoint_every
+        self._recover()
+        self._wal = open(os.path.join(directory, WAL_NAME), "ab")
+
+    # ------------------------------------------------------------- recovery
+
+    def _recover(self) -> None:
+        ckpt = os.path.join(self._dir, CKPT_NAME)
+        if os.path.exists(ckpt):
+            with open(ckpt, "rb") as f:
+                snap = msgpack.unpackb(f.read(), raw=True, strict_map_key=False)
+            self._blocks = {
+                bid: BlockEntry(e[0], list(e[1])) for bid, e in snap[b"blocks"].items()
+            }
+            self._chunks = {
+                h: ChunkLocation(*loc) for h, loc in snap[b"chunks"].items()
+            }
+            self._sealed = set(snap[b"sealed"])
+            self._seq = snap.get(b"seq", 0)
+        wal = os.path.join(self._dir, WAL_NAME)
+        if os.path.exists(wal):
+            with open(wal, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos + _HDR.size <= len(data):
+                ln, crc = _HDR.unpack_from(data, pos)
+                payload = data[pos + _HDR.size : pos + _HDR.size + ln]
+                if len(payload) < ln or native.crc32c(payload) != crc:
+                    break  # torn tail
+                seq, *rec = msgpack.unpackb(payload, raw=True, use_list=True)
+                if seq > self._seq:  # skip records the checkpoint already folded in
+                    self._apply(rec)
+                    self._seq = seq
+                pos += _HDR.size + ln
+
+    def _apply(self, rec: list) -> None:
+        op = rec[0]
+        if op == b"blk":  # [op, block_id, logical_len, [hashes], {hash: [cid,off,len]}]
+            _, bid, llen, hashes, new_chunks = rec
+            for h, loc in new_chunks.items():
+                self._chunks[h] = ChunkLocation(loc[0], loc[1], loc[2], 0)
+            for h in hashes:
+                self._chunks[h].refcount += 1
+            self._blocks[bid] = BlockEntry(llen, list(hashes))
+        elif op == b"del":  # [op, block_id]
+            entry = self._blocks.pop(rec[1], None)
+            if entry:
+                for h in entry.hashes:
+                    loc = self._chunks.get(h)
+                    if loc:
+                        loc.refcount -= 1
+                        if loc.refcount <= 0:
+                            del self._chunks[h]
+        elif op == b"seal":  # [op, container_id]
+            self._sealed.add(rec[1])
+        elif op == b"moved":  # [op, {hash: [cid, off, len]}] — compaction result
+            for h, loc in rec[1].items():
+                c = self._chunks.get(h)
+                if c is not None:
+                    c.container_id, c.offset, c.length = loc[0], loc[1], loc[2]
+        elif op == b"unseal":  # [op, container_id] — container deleted by GC
+            self._sealed.discard(rec[1])
+
+    # ------------------------------------------------------------------ WAL
+
+    def _commit(self, rec: list) -> None:
+        """Log, then apply, then maybe checkpoint.  Caller holds the lock.
+        A failed append raises *before* any in-memory mutation."""
+        payload = msgpack.packb([self._seq + 1, *rec])
+        fault_injection.point("index.wal_append")
+        self._wal.write(_HDR.pack(len(payload), native.crc32c(payload)) + payload)
+        self._wal.flush()
+        os.fsync(self._wal.fileno())
+        self._seq += 1
+        self._apply(rec)
+        self._ops_since_ckpt += 1
+        if self._ops_since_ckpt >= self._checkpoint_every:
+            self._checkpoint_locked()
+
+    # ------------------------------------------------------------- mutation
+
+    def lookup_chunks(self, hashes: list[bytes]) -> dict[bytes, ChunkLocation | None]:
+        """Batch fingerprint probe — the reference's per-thread Redis MULTI GET
+        (DataDeduplicator.java:588-610).  Returns copies: callers may hold the
+        results across a concurrent compaction commit."""
+        with self._lock:
+            return {h: dataclasses.replace(loc) if (loc := self._chunks.get(h))
+                    else None for h in hashes}
+
+    def commit_block(self, block_id: int, logical_len: int, hashes: list[bytes],
+                     new_chunks: dict[bytes, tuple[int, int, int]]) -> list[bytes]:
+        """Atomically commit a reduced block: register first-seen chunks at
+        their container locations, bump refcounts for every reference, and
+        write the Table-1 row.  One WAL record; replaces the reference's
+        unordered Redis SET pipeline (DataDeduplicator.java:372-392,803).
+
+        Two writers may race dedup'ing the same never-seen chunk: both will
+        have appended its bytes and both declare it in ``new_chunks``.  The
+        first commit wins; later commits keep the existing location and the
+        loser's container bytes become orphans (reclaimed by compaction).
+        Returns the fingerprints that lost such races."""
+        with self._lock:
+            losers = [h for h in new_chunks if h in self._chunks]
+            fresh = {h: loc for h, loc in new_chunks.items() if h not in self._chunks}
+            for h in hashes:
+                if h not in self._chunks and h not in fresh:
+                    raise ValueError(f"hash {h.hex()} neither known nor new")
+            self._commit([b"blk", block_id, logical_len, hashes,
+                          {h: [c, o, ln] for h, (c, o, ln) in fresh.items()}])
+            return losers
+
+    def delete_block(self, block_id: int) -> list[bytes]:
+        """Drop a block's Table-1 row and decref its chunks.  Returns the
+        fingerprints whose refcount reached zero (now dead; eligible for
+        container compaction)."""
+        with self._lock:
+            entry = self._blocks.get(block_id)
+            if entry is None:
+                return []
+            dead: list[bytes] = []
+            counts: dict[bytes, int] = {}
+            for h in entry.hashes:
+                counts[h] = counts.get(h, 0) + 1
+            for h, n in counts.items():
+                loc = self._chunks.get(h)
+                if loc and loc.refcount - n <= 0:
+                    dead.append(h)
+            self._commit([b"del", block_id])
+            return dead
+
+    def seal_container(self, container_id: int) -> None:
+        """Record that a container rolled over and was compressed
+        (DataDeduplicator.java:770-781's LZ4-on-rollover)."""
+        with self._lock:
+            self._commit([b"seal", container_id])
+
+    def record_moves(self, moves: dict[bytes, tuple[int, int, int]],
+                     dropped_container: int | None = None) -> None:
+        """Commit a compaction: chunks relocated to new container positions.
+        MUST be called after the new bytes are durably appended and *before*
+        the old container file is deleted (see ContainerStore.copy_live)."""
+        with self._lock:
+            self._commit([b"moved",
+                          {h: [c, o, ln] for h, (c, o, ln) in moves.items()}])
+            if dropped_container is not None:
+                self._commit([b"unseal", dropped_container])
+
+    # --------------------------------------------------------------- lookup
+
+    def get_block(self, block_id: int) -> BlockEntry | None:
+        with self._lock:
+            e = self._blocks.get(block_id)
+            return BlockEntry(e.logical_len, list(e.hashes)) if e else None
+
+    def has_block(self, block_id: int) -> bool:
+        with self._lock:
+            return block_id in self._blocks
+
+    def block_ids(self) -> list[int]:
+        with self._lock:
+            return sorted(self._blocks)
+
+    def chunk_location(self, h: bytes) -> ChunkLocation | None:
+        with self._lock:
+            loc = self._chunks.get(h)
+            return dataclasses.replace(loc) if loc else None
+
+    def is_sealed(self, container_id: int) -> bool:
+        with self._lock:
+            return container_id in self._sealed
+
+    def container_live_bytes(self) -> dict[int, int]:
+        """Live (referenced) bytes per container — compaction planning input."""
+        with self._lock:
+            out: dict[int, int] = {}
+            for loc in self._chunks.values():
+                out[loc.container_id] = out.get(loc.container_id, 0) + loc.length
+            return out
+
+    def live_chunks_in(self, container_id: int) -> dict[bytes, tuple[int, int]]:
+        """fingerprint -> (offset, length) for live chunks of one container."""
+        with self._lock:
+            return {h: (c.offset, c.length) for h, c in self._chunks.items()
+                    if c.container_id == container_id}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "blocks": len(self._blocks),
+                "chunks": len(self._chunks),
+                "sealed_containers": len(self._sealed),
+                "logical_bytes": sum(b.logical_len for b in self._blocks.values()),
+                "unique_chunk_bytes": sum(c.length for c in self._chunks.values()),
+            }
+
+    # ----------------------------------------------------------- checkpoint
+
+    def checkpoint(self) -> None:
+        with self._lock:
+            self._checkpoint_locked()
+
+    def _checkpoint_locked(self) -> None:
+        snap = {
+            "blocks": {bid: [e.logical_len, e.hashes] for bid, e in self._blocks.items()},
+            "chunks": {h: [c.container_id, c.offset, c.length, c.refcount]
+                       for h, c in self._chunks.items()},
+            "sealed": sorted(self._sealed),
+            "seq": self._seq,
+        }
+        tmp = os.path.join(self._dir, CKPT_TMP)
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(snap))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self._dir, CKPT_NAME))
+        # WAL records <= seq are folded into the checkpoint.  If we crash
+        # before the truncate, replay skips them by seqno (idempotent).
+        fault_injection.point("index.post_checkpoint")
+        wal = getattr(self, "_wal", None)
+        if wal is not None:
+            wal.truncate(0)
+            wal.seek(0)
+        else:  # during recovery (no WAL handle yet)
+            open(os.path.join(self._dir, WAL_NAME), "wb").close()
+        self._ops_since_ckpt = 0
+
+    def close(self) -> None:
+        with self._lock:
+            self._wal.close()

@@ -1,0 +1,74 @@
+"""Backend dispatch for the reduction hot ops (CDC scan + fingerprinting).
+
+The reference hardwires its hot loops: the CDC byte scan is a sequential Java
+loop (DataDeduplicator.chunking(), DataDeduplicator.java:264-307) and hashing
+goes through JNI to libnayuki (utilities.java:98-137).  Here both ops have two
+interchangeable backends with identical outputs (asserted in tests/test_ops.py):
+
+- ``native``: C++ via ctypes (hdrf_tpu/native) — the CPU baseline the >=4x
+  BASELINE target is measured against, and the correctness oracle.
+- ``tpu``:    JAX/XLA device programs (hdrf_tpu/ops/gear.py, sha256.py) — the
+  all-position Gear candidate scan and lane-parallel SHA-256.
+
+``auto`` resolves to ``tpu`` when an accelerator is attached.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hdrf_tpu.config import CdcConfig
+
+
+def resolve_backend(backend: str) -> str:
+    if backend != "auto":
+        return backend
+    try:
+        import jax
+
+        if any(d.platform == "tpu" for d in jax.devices()):
+            return "tpu"
+    except Exception:
+        pass
+    return "native"
+
+
+def gear_mask(cdc: CdcConfig) -> int:
+    """Boundary mask with ``mask_bits`` effective bits -> avg chunk 2^mask_bits.
+    Bits are spread across the 32-bit hash (FastCDC observation: spread masks
+    judge more of the window than low-contiguous ones)."""
+    bits, mask, step = cdc.mask_bits, 0, 32 // max(cdc.mask_bits, 1)
+    pos = 31
+    for _ in range(bits):
+        mask |= 1 << pos
+        pos -= step
+        if pos < 0:
+            pos = 30
+    return mask & 0xFFFFFFFF
+
+
+def chunk_cuts(data: bytes | np.ndarray, cdc: CdcConfig,
+               backend: str = "native") -> np.ndarray:
+    """Exclusive chunk end offsets covering [0, len(data)]."""
+    from hdrf_tpu import native
+
+    mask = gear_mask(cdc)
+    if backend == "tpu":
+        from hdrf_tpu.ops import gear
+
+        return gear.cdc_chunk_jax(data, mask, cdc.min_chunk, cdc.max_chunk)
+    return native.cdc_chunk(data, mask, cdc.min_chunk, cdc.max_chunk)
+
+
+def fingerprints(data: bytes | np.ndarray, cuts: np.ndarray,
+                 backend: str = "native") -> np.ndarray:
+    """(n_chunks, 32) SHA-256 digests of the chunks delimited by ``cuts``."""
+    if backend == "tpu":
+        from hdrf_tpu.ops import sha256 as sha_tpu
+
+        return sha_tpu.fingerprint_chunks(data, cuts)
+    from hdrf_tpu import native
+
+    starts = np.concatenate([[0], cuts[:-1]]).astype(np.uint64)
+    lens = (cuts - starts).astype(np.uint64)
+    return native.sha256_batch(data, starts, lens)

@@ -1,0 +1,137 @@
+"""Content-defined-chunking deduplication pipeline.
+
+The write side re-expresses DataDeduplicator.java's per-block pipeline
+(ctor :108-217): CDC chunking (:264-307) -> fingerprint (:312-332 via JNI SHA)
+-> duplicate check (:338-367) -> container append with compress-on-rollover
+(threadedStorer :652-845) -> index commit (:372-392).  The read side
+re-expresses DataConstructor.java: hash-list fetch (:222-235), metadata batch
+lookup + group-by-container (quickBuildMT :360-417), container read/decompress
+and scatter (threadedConstructor :430-567).
+
+Deliberate fixes over the reference:
+
+- **Intra-block dedup actually works.** The reference keys a
+  ``HashMap<byte[],...>`` on array identity, so duplicate chunks within one
+  block are never detected (DataDeduplicator.java:340-358).  Here fingerprints
+  are ``bytes`` keys; first occurrence wins.
+- **Atomic commit.** Chunk bytes are fsync'd into containers *before* the
+  single-WAL-record index commit, so a crash can orphan container bytes
+  (reclaimed by compaction) but never index a chunk without bytes.  The
+  reference's pipelined Redis SETs have no such ordering.
+- **Chunk-granular reads.** ``reconstruct(offset, length)`` touches only the
+  containers overlapping the requested range; the reference always
+  materializes the full 128 MB block (BlockSender.java:612-623).
+- **Refcounts + GC** (the reference's missing "Table #3",
+  DataDeduplicator.java:61-62).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hdrf_tpu.ops import dispatch
+from hdrf_tpu.reduction import scheme as scheme_mod
+from hdrf_tpu.reduction.scheme import ReductionContext, ReductionScheme
+from hdrf_tpu.utils import metrics, tracing
+
+_M = metrics.registry("dedup")
+
+
+class DedupScheme(ReductionScheme):
+    """CDC dedup; ``container_codec`` tells the DataNode how to build its
+    ContainerStore (the rollover compression stage — reference mode 1 rolls
+    containers uncompressed, mode 2 LZ4-compresses them)."""
+
+    def __init__(self, name: str, container_codec: str):
+        self.name = name
+        self.container_codec = container_codec
+
+    # --------------------------------------------------------------- write
+
+    def reduce(self, block_id: int, data: bytes, ctx: ReductionContext) -> bytes:
+        assert ctx.index is not None and ctx.containers is not None
+        tr = tracing.current_context()
+        with tracing.tracer("dedup").span("reduce", parent=tr) as sp:
+            buf = np.frombuffer(data, dtype=np.uint8)
+            cuts = dispatch.chunk_cuts(buf, ctx.config.cdc, ctx.backend)
+            digests = dispatch.fingerprints(buf, cuts, ctx.backend)
+            starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
+            n = len(cuts)
+
+            # Ordered fingerprint list + first-occurrence ranges.
+            hashes: list[bytes] = []
+            first_range: dict[bytes, tuple[int, int]] = {}
+            for i in range(n):
+                h = digests[i].tobytes()
+                hashes.append(h)
+                if h not in first_range:
+                    first_range[h] = (int(starts[i]), int(cuts[i] - starts[i]))
+
+            known = ctx.index.lookup_chunks(list(first_range))
+            new_hashes = [h for h, loc in known.items() if loc is None]
+            chunk_bytes = [data[o:o + ln] for o, ln in
+                           (first_range[h] for h in new_hashes)]
+            locs = ctx.containers.append_chunks(
+                chunk_bytes, on_seal=ctx.index.seal_container)
+            new_chunks = dict(zip(new_hashes, locs))
+            ctx.index.commit_block(block_id, len(data), hashes, new_chunks)
+
+            new_bytes = sum(ln for _, _, ln in locs)
+            sp.annotate("chunks", n)
+            sp.annotate("unique_new", len(new_hashes))
+            _M.incr("blocks_reduced")
+            _M.incr("chunks_total", n)
+            _M.incr("chunks_new", len(new_hashes))
+            _M.incr("bytes_logical", len(data))
+            _M.incr("bytes_new", new_bytes)
+        return b""  # replica data file stays empty by design
+
+    # ---------------------------------------------------------------- read
+
+    def reconstruct(self, block_id: int, stored: bytes, logical_len: int,
+                    ctx: ReductionContext, offset: int = 0,
+                    length: int = -1) -> bytes:
+        assert ctx.index is not None and ctx.containers is not None
+        entry = ctx.index.get_block(block_id)
+        if entry is None:
+            raise KeyError(f"block {block_id} not in chunk index")
+        end = entry.logical_len if length < 0 else min(offset + length,
+                                                       entry.logical_len)
+        if offset >= end:
+            return b""
+        locmap = ctx.index.lookup_chunks(list(set(entry.hashes)))
+        # Chunk-granular range selection over the logical layout.
+        out = bytearray(end - offset)
+        pos = 0
+        wanted: list[tuple[int, int, int]] = []  # (cid, off, len) per needed chunk
+        spans: list[tuple[int, int, int]] = []   # (out_at, src_from, n)
+        for h in entry.hashes:
+            loc = locmap[h]
+            if loc is None:
+                raise IOError(f"block {block_id}: chunk {h.hex()} missing from index")
+            c_start, c_len = pos, loc.length
+            pos += c_len
+            if c_start >= end or c_start + c_len <= offset:
+                continue
+            lo = max(offset, c_start) - c_start
+            hi = min(end, c_start + c_len) - c_start
+            wanted.append((loc.container_id, loc.offset, loc.length))
+            spans.append((max(offset, c_start) - offset, lo, hi - lo))
+        if pos != entry.logical_len:
+            raise IOError(f"block {block_id}: chunk lengths sum to {pos}, "
+                          f"index says {entry.logical_len}")
+        chunks = ctx.containers.read_chunks(wanted)
+        for chunk, (out_at, lo, n) in zip(chunks, spans):
+            out[out_at:out_at + n] = chunk[lo:lo + n]
+        _M.incr("blocks_reconstructed")
+        return bytes(out)
+
+    def delete(self, block_id: int, ctx: ReductionContext) -> None:
+        assert ctx.index is not None
+        dead = ctx.index.delete_block(block_id)
+        _M.incr("chunks_dead", len(dead))
+
+
+scheme_mod.register(DedupScheme("dedup", container_codec="none"))
+scheme_mod.register(DedupScheme("dedup_lz4", container_codec="lz4"))
+scheme_mod.register(DedupScheme("dedup_zstd", container_codec="zstd"))

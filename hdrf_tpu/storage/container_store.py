@@ -1,0 +1,239 @@
+"""Chunk-container store: append-only container files with seal-on-rollover.
+
+Re-expression of the reference's chunk store (threadedStorer,
+DataDeduplicator.java:652-845): chunks append to flat files
+``<chunkDir>/<containerID>`` up to 32 MB (DataNode.java:434 ``maxSize=2^25``),
+and a container is LZ4-compressed when it rolls over
+(DataDeduplicator.java:770-781).  Reads group chunks by container, decompress
+sealed containers, and slice chunks out (DataConstructor.threadedConstructor,
+DataConstructor.java:430-567, open-container fast path :482-490).
+
+Differences by design:
+
+- **Lanes, not threads-with-bit-tricks.** The reference namespaces container
+  ids with a 2-bit writer-thread field packed into 3 bytes
+  (utilities.java:36-75).  Here container ids are a flat monotonic counter;
+  concurrency comes from N independent *lanes*, each owning one open container
+  and its own lock.
+- **Sealed-ness is self-describing**: ``<cid>.raw`` (open) vs ``<cid>.sealed``
+  (codec-framed), no external state needed to read.
+- **Compaction exists** (the reference can never reclaim dead chunks).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from dataclasses import dataclass
+
+from hdrf_tpu.utils import codec as codecs
+from hdrf_tpu.utils import fault_injection, metrics
+
+_M = metrics.registry("container_store")
+
+_SEAL_HDR = struct.Struct("<IQI")  # magic, usize, codec id
+_SEAL_MAGIC = 0x48435452  # "RTCH"
+
+
+@dataclass
+class _Lane:
+    lock: threading.Lock
+    container_id: int = -1
+    size: int = 0
+    fh: object | None = None
+
+
+class ContainerStore:
+    """Append-only chunk containers with compress-on-seal and compaction."""
+
+    def __init__(self, directory: str, container_size: int = 1 << 25,
+                 lanes: int = 4, codec: str = "lz4", cache_containers: int = 4):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._container_size = container_size
+        self._codec = codec
+        self._alloc_lock = threading.Lock()
+        self._next_id = self._scan_next_id()
+        self._lanes = [_Lane(threading.Lock()) for _ in range(lanes)]
+        self._rr = 0
+        # Tiny LRU of decompressed sealed containers (read amplification guard;
+        # the reference re-decompresses the whole container per read).
+        self._cache: dict[int, bytes] = {}
+        self._cache_cap = cache_containers
+        self._cache_lock = threading.Lock()
+
+    def _scan_next_id(self) -> int:
+        mx = -1
+        for name in os.listdir(self._dir):
+            stem = name.split(".")[0]
+            if stem.isdigit():
+                mx = max(mx, int(stem))
+        return mx + 1
+
+    def _raw_path(self, cid: int) -> str:
+        return os.path.join(self._dir, f"{cid}.raw")
+
+    def _sealed_path(self, cid: int) -> str:
+        return os.path.join(self._dir, f"{cid}.sealed")
+
+    # -------------------------------------------------------------- writing
+
+    def append_chunks(self, chunks: list[bytes],
+                      on_seal=None) -> list[tuple[int, int, int]]:
+        """Append chunks to one lane's open container; returns
+        (container_id, offset, length) per chunk.  ``on_seal(cid)`` fires after
+        a rollover compresses+seals a container (index notification)."""
+        if not chunks:  # fully-deduplicated block: nothing new to store
+            return []
+        with self._alloc_lock:
+            lane = self._lanes[self._rr % len(self._lanes)]
+            self._rr += 1
+        out: list[tuple[int, int, int]] = []
+        with lane.lock:
+            for chunk in chunks:
+                if lane.fh is None or (
+                        lane.size + len(chunk) > self._container_size and lane.size > 0):
+                    if lane.fh is not None:
+                        self._seal_locked(lane, on_seal)
+                    self._open_locked(lane)
+                off = lane.size
+                lane.fh.write(chunk)
+                lane.size += len(chunk)
+                out.append((lane.container_id, off, len(chunk)))
+            lane.fh.flush()
+            os.fsync(lane.fh.fileno())
+        _M.incr("chunks_appended", len(chunks))
+        return out
+
+    def _open_locked(self, lane: _Lane) -> None:
+        with self._alloc_lock:
+            cid = self._next_id
+            self._next_id += 1
+        lane.container_id = cid
+        lane.size = 0
+        lane.fh = open(self._raw_path(cid), "wb")
+
+    def _seal_locked(self, lane: _Lane, on_seal) -> None:
+        lane.fh.close()
+        self.seal(lane.container_id)
+        if on_seal is not None:
+            on_seal(lane.container_id)
+        lane.fh = None
+
+    def seal(self, cid: int) -> None:
+        """Compress a raw container into the sealed format (the rollover LZ4
+        pass, DataDeduplicator.java:770-781)."""
+        raw = self._raw_path(cid)
+        with open(raw, "rb") as f:
+            data = f.read()
+        fault_injection.point("container.seal")
+        comp = codecs.compress(self._codec, data)
+        codec = self._codec
+        if len(comp) >= len(data):  # incompressible: store raw inside the frame
+            comp, codec = data, "none"
+        tmp = self._sealed_path(cid) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_SEAL_HDR.pack(_SEAL_MAGIC, len(data), codecs.CODEC_IDS[codec]))
+            f.write(comp)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._sealed_path(cid))
+        os.unlink(raw)
+        _M.incr("sealed")
+
+    def flush_open(self, on_seal=None) -> None:
+        """Seal every open lane (shutdown/test hook)."""
+        for lane in self._lanes:
+            with lane.lock:
+                if lane.fh is not None and lane.size > 0:
+                    self._seal_locked(lane, on_seal)
+                elif lane.fh is not None:
+                    lane.fh.close()
+                    os.unlink(self._raw_path(lane.container_id))
+                    lane.fh = None
+
+    # -------------------------------------------------------------- reading
+
+    def read_container(self, cid: int) -> bytes:
+        """Full uncompressed container bytes (open or sealed)."""
+        with self._cache_lock:
+            if cid in self._cache:
+                _M.incr("cache_hit")
+                return self._cache[cid]
+        try:
+            # Still-open container: read raw bytes directly
+            # (DataConstructor.java:482-490's skip-decompress path).  Open
+            # without an exists() pre-check: a concurrent seal unlinks the raw
+            # file only *after* the sealed file is in place, so on ENOENT the
+            # sealed path below is guaranteed readable.
+            with open(self._raw_path(cid), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            pass
+        with open(self._sealed_path(cid), "rb") as f:
+            magic, usize, codec_id = _SEAL_HDR.unpack(f.read(_SEAL_HDR.size))
+            if magic != _SEAL_MAGIC:
+                raise IOError(f"container {cid}: bad magic {magic:#x}")
+            data = codecs.decompress(codecs.CODEC_NAMES[codec_id], f.read(), usize)
+        with self._cache_lock:
+            self._cache[cid] = data
+            while len(self._cache) > self._cache_cap:
+                self._cache.pop(next(iter(self._cache)))
+        return data
+
+    def read_chunks(self, locs: list[tuple[int, int, int]]) -> list[bytes]:
+        """Fetch many chunks, grouping by container so each container is read
+        and decompressed once (quickBuildMT's grouping,
+        DataConstructor.java:375-395)."""
+        by_cid: dict[int, list[int]] = {}
+        for i, (cid, _, _) in enumerate(locs):
+            by_cid.setdefault(cid, []).append(i)
+        out: list[bytes | None] = [None] * len(locs)
+        for cid, idxs in by_cid.items():
+            data = self.read_container(cid)
+            for i in idxs:
+                _, off, ln = locs[i]
+                out[i] = data[off:off + ln]
+        return out  # type: ignore[return-value]
+
+    # ----------------------------------------------------------- compaction
+
+    def copy_live(self, cid: int, live: dict[bytes, tuple[int, int]],
+                  on_seal=None) -> dict[bytes, tuple[int, int, int]]:
+        """Copy a container's *live* chunks into the current open lane.
+        ``live`` maps fingerprint -> (offset, len) within ``cid``.  Returns
+        fingerprint -> new (cid, off, len).
+
+        Compaction protocol (crash-safe ordering): ``copy_live`` (bytes
+        durable in new container) -> ``ChunkIndex.record_moves`` (index commit)
+        -> ``delete_container(cid)``.  A crash before the index commit leaves
+        only orphan copies; the old container is deleted strictly after the
+        index stops referencing it."""
+        data = self.read_container(cid)
+        hashes = list(live.keys())
+        chunks = [data[off:off + ln] for off, ln in (live[h] for h in hashes)]
+        new_locs = self.append_chunks(chunks, on_seal=on_seal)
+        return dict(zip(hashes, new_locs))
+
+    def delete_container(self, cid: int) -> None:
+        for p in (self._raw_path(cid), self._sealed_path(cid)):
+            if os.path.exists(p):
+                os.unlink(p)
+        with self._cache_lock:
+            self._cache.pop(cid, None)
+
+    def container_ids(self) -> list[int]:
+        ids = set()
+        for name in os.listdir(self._dir):
+            stem = name.split(".")[0]
+            if stem.isdigit() and (name.endswith(".raw") or name.endswith(".sealed")):
+                ids.add(int(stem))
+        return sorted(ids)
+
+    def physical_bytes(self) -> int:
+        total = 0
+        for name in os.listdir(self._dir):
+            if name.endswith(".raw") or name.endswith(".sealed"):
+                total += os.path.getsize(os.path.join(self._dir, name))
+        return total

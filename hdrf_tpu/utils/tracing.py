@@ -101,3 +101,17 @@ def current_context() -> tuple[int, int] | None:
     """Wire context of the active span, to stamp into outgoing op headers."""
     sp = _current_span.get()
     return None if sp is None else sp.context()
+
+
+_tracers: dict[str, Tracer] = {}
+_tracers_lock = threading.Lock()
+
+
+def tracer(name: str) -> Tracer:
+    """Process-wide named tracer (one per daemon/subsystem, like the per-daemon
+    Tracer builds at DataNode.java:402-407)."""
+    with _tracers_lock:
+        t = _tracers.get(name)
+        if t is None:
+            t = _tracers[name] = Tracer(name)
+        return t

@@ -1,0 +1,172 @@
+"""ChunkIndex: WAL durability, refcounts, recovery, torn-tail tolerance."""
+
+import os
+import struct
+
+import pytest
+
+from hdrf_tpu.index.chunk_index import ChunkIndex
+
+
+def h(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def test_commit_and_lookup(tmp_path):
+    idx = ChunkIndex(str(tmp_path))
+    idx.commit_block(1, 100, [h(1), h(2), h(1)],
+                     {h(1): (0, 0, 40), h(2): (0, 40, 20)})
+    e = idx.get_block(1)
+    assert e.logical_len == 100
+    assert e.hashes == [h(1), h(2), h(1)]
+    locs = idx.lookup_chunks([h(1), h(2), h(3)])
+    assert locs[h(1)].refcount == 2  # two references from block 1
+    assert locs[h(2)].refcount == 1
+    assert locs[h(3)] is None
+    idx.close()
+
+
+def test_cross_block_dedup_refcounts(tmp_path):
+    idx = ChunkIndex(str(tmp_path))
+    idx.commit_block(1, 60, [h(1)], {h(1): (0, 0, 60)})
+    idx.commit_block(2, 60, [h(1)], {})  # second block reuses the chunk
+    assert idx.chunk_location(h(1)).refcount == 2
+    assert idx.delete_block(1) == []  # still referenced by block 2
+    assert idx.chunk_location(h(1)).refcount == 1
+    assert idx.delete_block(2) == [h(1)]  # now dead
+    assert idx.chunk_location(h(1)) is None
+    idx.close()
+
+
+def test_commit_validates(tmp_path):
+    idx = ChunkIndex(str(tmp_path))
+    with pytest.raises(ValueError):
+        idx.commit_block(1, 10, [h(9)], {})  # unknown hash, not declared new
+    idx.commit_block(1, 10, [h(1)], {h(1): (0, 0, 10)})
+    idx.close()
+
+
+def test_concurrent_new_chunk_race_first_commit_wins(tmp_path):
+    # Two writers dedup the same never-seen chunk concurrently: both append
+    # bytes and declare it new. First commit registers it; second keeps the
+    # existing location and is told its copy is an orphan.
+    idx = ChunkIndex(str(tmp_path))
+    assert idx.commit_block(1, 10, [h(1)], {h(1): (0, 0, 10)}) == []
+    losers = idx.commit_block(2, 10, [h(1)], {h(1): (3, 50, 10)})
+    assert losers == [h(1)]
+    loc = idx.chunk_location(h(1))
+    assert (loc.container_id, loc.offset) == (0, 0)  # first commit won
+    assert loc.refcount == 2
+    idx.close()
+
+
+def test_checkpoint_crash_before_truncate_is_idempotent(tmp_path):
+    # Crash between checkpoint publish and WAL truncation: replay must not
+    # double-apply records the checkpoint folded in (refcount inflation).
+    from hdrf_tpu.utils import fault_injection
+
+    idx = ChunkIndex(str(tmp_path))
+    idx.commit_block(1, 10, [h(1)], {h(1): (0, 0, 10)})
+
+    class Crash(Exception):
+        pass
+
+    with fault_injection.inject("index.post_checkpoint",
+                                lambda **kw: (_ for _ in ()).throw(Crash())):
+        with pytest.raises(Crash):
+            idx.checkpoint()
+    idx.close()
+    # WAL still holds the blk record AND the checkpoint contains it.
+    idx2 = ChunkIndex(str(tmp_path))
+    assert idx2.chunk_location(h(1)).refcount == 1  # not inflated to 2
+    assert idx2.delete_block(1) == [h(1)]  # chunk correctly dies
+    idx2.close()
+
+
+def test_recovery_from_wal(tmp_path):
+    idx = ChunkIndex(str(tmp_path))
+    idx.commit_block(1, 100, [h(1), h(2)], {h(1): (0, 0, 50), h(2): (0, 50, 50)})
+    idx.seal_container(0)
+    idx.close()
+
+    idx2 = ChunkIndex(str(tmp_path))
+    assert idx2.get_block(1).hashes == [h(1), h(2)]
+    assert idx2.is_sealed(0)
+    assert idx2.chunk_location(h(2)).offset == 50
+    idx2.close()
+
+
+def test_recovery_checkpoint_plus_wal(tmp_path):
+    idx = ChunkIndex(str(tmp_path))
+    idx.commit_block(1, 10, [h(1)], {h(1): (0, 0, 10)})
+    idx.checkpoint()
+    idx.commit_block(2, 20, [h(2)], {h(2): (0, 10, 20)})  # post-ckpt, WAL only
+    idx.close()
+
+    idx2 = ChunkIndex(str(tmp_path))
+    assert idx2.has_block(1) and idx2.has_block(2)
+    idx2.close()
+
+
+def test_torn_wal_tail_dropped(tmp_path):
+    idx = ChunkIndex(str(tmp_path))
+    idx.commit_block(1, 10, [h(1)], {h(1): (0, 0, 10)})
+    idx.commit_block(2, 20, [h(2)], {h(2): (0, 10, 20)})
+    idx.close()
+
+    wal = tmp_path / "index.wal"
+    data = wal.read_bytes()
+    wal.write_bytes(data[:-3])  # torn final record
+
+    idx2 = ChunkIndex(str(tmp_path))
+    assert idx2.has_block(1)
+    assert not idx2.has_block(2)  # torn record dropped, prefix intact
+    idx2.close()
+
+
+def test_corrupt_wal_record_stops_replay(tmp_path):
+    idx = ChunkIndex(str(tmp_path))
+    idx.commit_block(1, 10, [h(1)], {h(1): (0, 0, 10)})
+    idx.close()
+    wal = tmp_path / "index.wal"
+    data = bytearray(wal.read_bytes())
+    data[12] ^= 0xFF  # flip a payload byte -> CRC mismatch
+    wal.write_bytes(bytes(data))
+    idx2 = ChunkIndex(str(tmp_path))
+    assert not idx2.has_block(1)
+    idx2.close()
+
+
+def test_auto_checkpoint(tmp_path):
+    idx = ChunkIndex(str(tmp_path), checkpoint_every=3)
+    for i in range(1, 5):
+        idx.commit_block(i, 10, [h(i)], {h(i): (0, i * 10, 10)})
+    assert os.path.exists(tmp_path / "index.ckpt")
+    # WAL was truncated at checkpoint; only post-ckpt records remain.
+    assert os.path.getsize(tmp_path / "index.wal") < 200
+    idx.close()
+    idx2 = ChunkIndex(str(tmp_path))
+    assert all(idx2.has_block(i) for i in range(1, 5))
+    idx2.close()
+
+
+def test_record_moves_and_live_bytes(tmp_path):
+    idx = ChunkIndex(str(tmp_path))
+    idx.commit_block(1, 30, [h(1), h(2)], {h(1): (0, 0, 10), h(2): (0, 10, 20)})
+    assert idx.container_live_bytes() == {0: 30}
+    idx.record_moves({h(1): (5, 0, 10), h(2): (5, 10, 20)}, dropped_container=0)
+    assert idx.container_live_bytes() == {5: 30}
+    assert idx.chunk_location(h(1)).container_id == 5
+    idx.close()
+    idx2 = ChunkIndex(str(tmp_path))
+    assert idx2.chunk_location(h(2)).container_id == 5
+    idx2.close()
+
+
+def test_stats(tmp_path):
+    idx = ChunkIndex(str(tmp_path))
+    idx.commit_block(1, 100, [h(1), h(1)], {h(1): (0, 0, 50)})
+    s = idx.stats()
+    assert s == {"blocks": 1, "chunks": 1, "sealed_containers": 0,
+                 "logical_bytes": 100, "unique_chunk_bytes": 50}
+    idx.close()
