@@ -1,0 +1,205 @@
+"""Client: filesystem API over the control RPC + data transfer protocol.
+
+Re-expression of the reference's client stack — DistributedFileSystem ->
+DFSClient (DFSClient.java:204; open :967, create :1116), DFSOutputStream +
+DataStreamer (block write pipeline, DataStreamer.java:655, pipeline setup
+:1655/:1702), DFSInputStream (read with location failover,
+DFSInputStream.java:817 -> blockSeekTo :539) — as a compact synchronous
+client:
+
+- ``write``: create -> per block: add_block -> stream packets to the first
+  target (which mirrors downstream) -> final aggregated ack -> complete.
+  Pipeline failure recovery is block-granular: abandon the block and
+  re-request targets (the reference swaps the bad node mid-block,
+  DataStreamer pipeline recovery; block-granular retry is the simpler
+  equivalent with identical durability).
+- ``read``: get_block_locations -> per block: try each replica location in
+  order, failing over on connection/checksum errors (read failover,
+  DFSInputStream.java:621+).  Range reads request only the overlapping
+  blocks and byte ranges (reconstruction stays chunk-granular end-to-end).
+"""
+
+from __future__ import annotations
+
+import socket
+import uuid
+
+from hdrf_tpu import native
+from hdrf_tpu.config import ClientConfig
+from hdrf_tpu.proto import datatransfer as dt
+from hdrf_tpu.proto.rpc import RpcClient, recv_frame
+from hdrf_tpu.utils import metrics, tracing
+
+_M = metrics.registry("client")
+_TR = tracing.tracer("client")
+
+
+class HdrfClient:
+    def __init__(self, namenode_addr: tuple[str, int],
+                 config: ClientConfig | None = None, name: str | None = None):
+        self.config = config or ClientConfig()
+        self.name = name or f"client-{uuid.uuid4().hex[:8]}"
+        self._nn = RpcClient(namenode_addr)
+
+    def close(self) -> None:
+        self._nn.close()
+
+    def __enter__(self) -> "HdrfClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---------------------------------------------------------- namespace ops
+
+    def mkdir(self, path: str) -> bool:
+        return self._nn.call("mkdir", path=path)
+
+    def delete(self, path: str) -> bool:
+        return self._nn.call("delete", path=path)
+
+    def rename(self, src: str, dst: str) -> bool:
+        return self._nn.call("rename", src=src, dst=dst)
+
+    def ls(self, path: str) -> list[dict]:
+        return self._nn.call("listing", path=path)
+
+    def stat(self, path: str) -> dict:
+        return self._nn.call("stat", path=path)
+
+    def exists(self, path: str) -> bool:
+        try:
+            self._nn.call("stat", path=path)
+            return True
+        except Exception:
+            return False
+
+    def datanode_report(self) -> list[dict]:
+        return self._nn.call("datanode_report")
+
+    # ----------------------------------------------------------------- write
+
+    def write(self, path: str, data: bytes, scheme: str | None = None,
+              replication: int | None = None) -> None:
+        """Write a whole file (the put path, §3.1 of SURVEY.md)."""
+        with _TR.span("write") as sp:
+            sp.annotate("path", path)
+            sp.annotate("bytes", len(data))
+            info = self._nn.call("create", path=path, client=self.name,
+                                 replication=replication, scheme=scheme)
+            block_size = info["block_size"]
+            lengths: dict[int, int] = {}
+            off = 0
+            while True:
+                block = data[off:off + block_size]
+                bid = self._write_block(path, block)
+                lengths[bid] = len(block)
+                off += block_size
+                if off >= len(data):
+                    break
+            self._nn.call("complete", path=path, client=self.name,
+                          block_lengths=lengths)
+            _M.incr("files_written")
+            _M.incr("bytes_written", len(data))
+
+    def _write_block(self, path: str, block: bytes, retries: int = 3) -> int:
+        last_err: Exception | None = None
+        for _ in range(retries):
+            alloc = self._nn.call("add_block", path=path, client=self.name)
+            bid = alloc["block_id"]
+            try:
+                self._stream_block(alloc, block)
+                return bid
+            except (OSError, ConnectionError, IOError) as e:
+                last_err = e
+                _M.incr("block_write_retries")
+                self._nn.call("abandon_block", path=path, client=self.name,
+                              block_id=bid)
+        raise IOError(f"block write failed after {retries} attempts: {last_err}")
+
+    def _stream_block(self, alloc: dict, block: bytes) -> None:
+        targets = alloc["targets"]
+        sock = socket.create_connection(tuple(targets[0]["addr"]), timeout=120)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            dt.send_op(sock, dt.WRITE_BLOCK, block_id=alloc["block_id"],
+                       gen_stamp=alloc["gen_stamp"], scheme=alloc["scheme"],
+                       targets=targets[1:])
+            npkts = dt.stream_bytes(sock, block, self.config.packet_size)
+            # Drain per-packet acks; the final one carries pipeline status.
+            status = dt.ACK_SUCCESS
+            for _ in range(npkts):
+                _, status = dt.read_ack(sock)
+            if status != dt.ACK_SUCCESS:
+                raise IOError(f"pipeline returned status {status}")
+        finally:
+            sock.close()
+
+    # ------------------------------------------------------------------ read
+
+    def read(self, path: str, offset: int = 0, length: int = -1) -> bytes:
+        """Read [offset, offset+length) of a file (whole file by default)."""
+        with _TR.span("read") as sp:
+            sp.annotate("path", path)
+            loc = self._nn.call("get_block_locations", path=path)
+            total = loc["length"]
+            end = total if length < 0 else min(offset + length, total)
+            if offset >= end:
+                return b""
+            out = bytearray()
+            pos = 0
+            for binfo in loc["blocks"]:
+                blen = binfo["length"]
+                bstart, bend = pos, pos + blen
+                pos = bend
+                if bend <= offset or bstart >= end:
+                    continue
+                lo = max(offset, bstart) - bstart
+                hi = min(end, bend) - bstart
+                out += self._read_block(binfo, lo, hi - lo)
+            _M.incr("files_read")
+            _M.incr("bytes_read", len(out))
+            return bytes(out)
+
+    def _read_block(self, binfo: dict, offset: int, length: int) -> bytes:
+        locations = binfo["locations"]
+        if not locations:
+            raise IOError(f"block {binfo['block_id']} has no live locations")
+        last_err: Exception | None = None
+        for loc in locations:  # failover across replicas
+            try:
+                return self._read_from(tuple(loc["addr"]), binfo["block_id"],
+                                       offset, length)
+            except (OSError, ConnectionError, IOError) as e:
+                last_err = e
+                _M.incr("read_failovers")
+        raise IOError(f"all {len(locations)} locations failed for block "
+                      f"{binfo['block_id']}: {last_err}")
+
+    def _read_from(self, addr: tuple[str, int], block_id: int, offset: int,
+                   length: int) -> bytes:
+        sock = socket.create_connection(addr, timeout=120)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            dt.send_op(sock, dt.READ_BLOCK, block_id=block_id, offset=offset,
+                       length=length)
+            hdr = recv_frame(sock)
+            if hdr["status"] != 0:
+                raise IOError(f"datanode error: {hdr['error']}: {hdr['message']}")
+            data = dt.collect_packets(sock)
+            if len(data) != hdr["length"]:
+                raise IOError(f"short read: {len(data)} != {hdr['length']}")
+            # End-to-end verify when the range aligns with checksum chunks
+            # (full-block reads always do).
+            cchunk = hdr["checksum_chunk"]
+            if hdr["checksums"] and offset % cchunk == 0:
+                stored = hdr["checksums"][offset // cchunk:]
+                for i in range(0, len(data) // cchunk + (1 if len(data) % cchunk else 0)):
+                    piece = data[i * cchunk:(i + 1) * cchunk]
+                    if (len(piece) == cchunk or offset + len(data) == hdr["logical_len"]) \
+                            and i < len(stored):
+                        if native.crc32c(piece) != stored[i]:
+                            raise IOError(f"checksum mismatch at chunk {i}")
+            return data
+        finally:
+            sock.close()

@@ -57,6 +57,9 @@ class ReductionConfig:
     # ticket queues at DataXceiver.java:313-380).
     max_concurrent_writes: int = 4
     max_concurrent_reads: int = 8
+    # Streaming (direct-scheme) writes: wide like the reference's direct mode
+    # (999 at DataNode.java:499-510) but still bounded.
+    max_concurrent_direct: int = 64
     # Chunk container rollover size (reference: 2**25 at DataNode.java:434).
     container_size: int = 1 << 25
     # Compress containers on rollover (reference: LZ4 at DataDeduplicator.java:770-781).

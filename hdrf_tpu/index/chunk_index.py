@@ -31,16 +31,12 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import struct
 import threading
 from dataclasses import dataclass
 
 import msgpack
 
-from hdrf_tpu import native
-from hdrf_tpu.utils import fault_injection
-
-_HDR = struct.Struct("<II")
+from hdrf_tpu.utils import fault_injection, wal as walmod
 
 WAL_NAME = "index.wal"
 CKPT_NAME = "index.ckpt"
@@ -98,21 +94,13 @@ class ChunkIndex:
             }
             self._sealed = set(snap[b"sealed"])
             self._seq = snap.get(b"seq", 0)
-        wal = os.path.join(self._dir, WAL_NAME)
-        if os.path.exists(wal):
-            with open(wal, "rb") as f:
-                data = f.read()
-            pos = 0
-            while pos + _HDR.size <= len(data):
-                ln, crc = _HDR.unpack_from(data, pos)
-                payload = data[pos + _HDR.size : pos + _HDR.size + ln]
-                if len(payload) < ln or native.crc32c(payload) != crc:
-                    break  # torn tail
-                seq, *rec = msgpack.unpackb(payload, raw=True, use_list=True)
-                if seq > self._seq:  # skip records the checkpoint already folded in
-                    self._apply(rec)
-                    self._seq = seq
-                pos += _HDR.size + ln
+        # recover() truncates any torn tail so the append handle continues at
+        # the good prefix (otherwise post-crash records land behind garbage).
+        for payload in walmod.recover(os.path.join(self._dir, WAL_NAME)):
+            seq, *rec = msgpack.unpackb(payload, raw=True, use_list=True)
+            if seq > self._seq:  # skip records the checkpoint already folded in
+                self._apply(rec)
+                self._seq = seq
 
     def _apply(self, rec: list) -> None:
         op = rec[0]
@@ -149,7 +137,7 @@ class ChunkIndex:
         A failed append raises *before* any in-memory mutation."""
         payload = msgpack.packb([self._seq + 1, *rec])
         fault_injection.point("index.wal_append")
-        self._wal.write(_HDR.pack(len(payload), native.crc32c(payload)) + payload)
+        self._wal.write(walmod.frame(payload))
         self._wal.flush()
         os.fsync(self._wal.fileno())
         self._seq += 1

@@ -1,0 +1,125 @@
+"""Bulk data-transfer protocol: framed packet streaming on a raw TCP socket.
+
+Modeled on the reference's ``DataTransferProtocol``
+(hadoop-hdfs-client/.../datatransfer/DataTransferProtocol.java:42): a
+connection carries one op — an op header, then for WRITE/READ a run of framed
+packets with per-packet checksums, with acks flowing back on the same socket
+(BlockReceiver's PacketResponder, BlockReceiver.java:1509).
+
+Wire layout:
+
+- Op header: one msgpack frame ``[op_name, fields_dict]`` (length-prefixed via
+  proto.rpc.send_frame).  ``fields["_trace"]`` resumes a client span
+  server-side (Receiver.java:94-98 continueTraceSpan).
+- Packet:    ``[u32 data_len][u64 seqno][u8 flags][u32 crc32c(data)]`` + data
+  (the reference's PacketHeader: 64 KB default payload, crc per checksum chunk;
+  here one crc32c per packet — checksum chunking for range reads lives in
+  BlockMeta.checksums).
+- Ack:       ``[u64 seqno][u8 status]`` per packet, status 0 = SUCCESS; for
+  pipelines the ack aggregates downstream status (worst wins), the analog of
+  PipelineAck.
+
+Ops (Receiver.java:101-135 op dispatch analog): WRITE_BLOCK, READ_BLOCK,
+TRANSFER_BLOCK, COPY_BLOCK, BLOCK_CHECKSUM — dispatched by the DataNode's
+xceiver loop.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any, Iterator
+
+from hdrf_tpu import native
+from hdrf_tpu.proto.rpc import recv_exact, recv_frame, send_frame
+from hdrf_tpu.utils import tracing
+
+PKT_HDR = struct.Struct("<IQBI")
+FLAG_LAST = 0x1
+
+ACK = struct.Struct("<QB")
+ACK_SUCCESS = 0
+ACK_ERROR = 1
+
+DEFAULT_PACKET = 64 * 1024
+
+# Op names (DataTransferProtocol.java op codes)
+WRITE_BLOCK = "write_block"
+READ_BLOCK = "read_block"
+TRANSFER_BLOCK = "transfer_block"
+COPY_BLOCK = "copy_block"
+BLOCK_CHECKSUM = "block_checksum"
+
+
+def send_op(sock: socket.socket, op: str, **fields: Any) -> None:
+    tr = tracing.current_context()
+    if tr is not None:
+        fields["_trace"] = list(tr)
+    send_frame(sock, [op, fields])
+
+
+def recv_op(sock: socket.socket) -> tuple[str, dict]:
+    op, fields = recv_frame(sock)
+    return op, fields
+
+
+def write_packet(sock: socket.socket, seqno: int, data: bytes,
+                 last: bool = False) -> None:
+    flags = FLAG_LAST if last else 0
+    sock.sendall(PKT_HDR.pack(len(data), seqno, flags, native.crc32c(data)))
+    if data:
+        sock.sendall(data)
+
+
+def read_packet(sock: socket.socket) -> tuple[int, bytes, bool]:
+    """Returns (seqno, data, last); raises IOError on checksum mismatch —
+    the receiver-side verify the reference does per checksum chunk."""
+    ln, seqno, flags, crc = PKT_HDR.unpack(recv_exact(sock, PKT_HDR.size))
+    data = recv_exact(sock, ln) if ln else b""
+    if native.crc32c(data) != crc:
+        raise IOError(f"packet {seqno}: checksum mismatch")
+    return seqno, data, bool(flags & FLAG_LAST)
+
+
+def iter_packets(sock: socket.socket) -> Iterator[tuple[int, bytes, bool]]:
+    while True:
+        seqno, data, last = read_packet(sock)
+        yield seqno, data, last
+        if last:
+            return
+
+
+def send_ack(sock: socket.socket, seqno: int, status: int = ACK_SUCCESS) -> None:
+    sock.sendall(ACK.pack(seqno, status))
+
+
+def read_ack(sock: socket.socket) -> tuple[int, int]:
+    seqno, status = ACK.unpack(recv_exact(sock, ACK.size))
+    return seqno, status
+
+
+def stream_bytes(sock: socket.socket, data: bytes,
+                 packet_size: int = DEFAULT_PACKET, base_seqno: int = 0) -> int:
+    """Packetize ``data`` onto the socket, ending with an empty LAST packet
+    (the reference's zero-payload trailer that carries lastPacketInBlock).
+    Returns the number of packets sent."""
+    seqno = base_seqno
+    for off in range(0, len(data), packet_size):
+        write_packet(sock, seqno, data[off:off + packet_size])
+        seqno += 1
+    write_packet(sock, seqno, b"", last=True)
+    return seqno - base_seqno + 1
+
+
+def collect_packets(sock: socket.socket, ack_sock: socket.socket | None = None,
+                    on_packet=None) -> bytes:
+    """Receive a full packet run; optionally ack each packet on ``ack_sock``
+    and/or forward via ``on_packet(seqno, data, last)`` (mirroring hook)."""
+    parts: list[bytes] = []
+    for seqno, data, last in iter_packets(sock):
+        parts.append(data)
+        if on_packet is not None:
+            on_packet(seqno, data, last)
+        if ack_sock is not None:
+            send_ack(ack_sock, seqno)
+    return b"".join(parts)

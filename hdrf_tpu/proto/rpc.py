@@ -1,0 +1,196 @@
+"""Control-plane RPC: length-prefixed msgpack frames over TCP.
+
+Plays the role of Hadoop IPC (protobuf-over-IPC services + the ``protocolPB``
+translator layers, ~12 kLoC in the reference) for all NN<->client and NN<->DN
+control traffic.  One frame = [u32 len][msgpack body].
+
+Request body:  ``[req_id, method, kwargs]``; kwargs may carry ``_trace``, a
+``(trace_id, span_id)`` pair resumed server-side (the reference's
+``continueTraceSpan``, Receiver.java:94-98).
+Response body: ``[req_id, 0, result]`` or ``[req_id, 1, {"error", "message"}]``
+— errors round-trip as :class:`RpcError` (the IPC RemoteException analog).
+
+Server threading model is thread-per-connection, mirroring the reference's
+thread-per-DataXceiver design (DataXceiverServer.java:44).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any
+
+import msgpack
+
+from hdrf_tpu.utils import metrics, tracing
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class RpcError(Exception):
+    """Server-side exception re-raised at the caller (RemoteException analog)."""
+
+    def __init__(self, error: str, message: str):
+        super().__init__(f"{error}: {message}")
+        self.error = error
+        self.message = message
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed connection")
+        got += r
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, body: Any) -> None:
+    payload = msgpack.packb(body)
+    if len(payload) > MAX_FRAME:
+        raise ValueError(f"frame too large: {len(payload)}")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME:
+        raise ConnectionError(f"oversized frame: {n}")
+    return msgpack.unpackb(recv_exact(sock, n), raw=False, use_list=True,
+                           strict_map_key=False)
+
+
+class RpcServer:
+    """Serves ``rpc_*`` methods of a service object.
+
+    >>> class Svc:
+    ...     def rpc_add(self, a, b): return a + b
+    >>> srv = RpcServer("127.0.0.1", 0, Svc(), "test"); srv.start()
+    """
+
+    def __init__(self, host: str, port: int, service: Any, name: str):
+        self._service = service
+        self._name = name
+        self._metrics = metrics.registry(f"rpc.{name}")
+        self._tracer = tracing.tracer(f"rpc.{name}")
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # one thread per connection
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                outer._conns.add(sock)
+                try:
+                    while True:
+                        req = recv_frame(sock)
+                        send_frame(sock, outer._dispatch(req))
+                except (ConnectionError, OSError):
+                    return
+                finally:
+                    outer._conns.discard(sock)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self._conns: set[socket.socket] = set()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._server.server_address  # resolved (host, real_port)
+
+    def _dispatch(self, req: list) -> list:
+        req_id, method, kwargs = req
+        trace = kwargs.pop("_trace", None)
+        fn = getattr(self._service, f"rpc_{method}", None)
+        if fn is None:
+            return [req_id, 1, {"error": "NoSuchMethod", "message": method}]
+        with self._tracer.span(method, parent=tuple(trace) if trace else None):
+            try:
+                with self._metrics.time(f"{method}_us"):
+                    result = fn(**kwargs)
+                self._metrics.incr(f"{method}_calls")
+                return [req_id, 0, result]
+            except Exception as e:  # noqa: BLE001 — errors cross the wire
+                self._metrics.incr(f"{method}_errors")
+                return [req_id, 1, {"error": type(e).__name__, "message": str(e)}]
+
+    def start(self) -> "RpcServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"rpc-{self._name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        # Sever live connections too: a stopped server must look DEAD to its
+        # peers (handler threads would otherwise keep answering RPCs — clients
+        # of a restarted daemon would talk to the zombie forever).
+        for s in list(self._conns):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            s.close()
+
+
+class RpcClient:
+    """Blocking RPC client; one socket, requests serialized by a lock.
+    Reconnects on the next call after a connection failure."""
+
+    def __init__(self, addr: tuple[str, int], timeout: float = 30.0):
+        self._addr = (addr[0], addr[1])
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._req_id = 0
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection(self._addr, timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def call(self, method: str, **kwargs: Any) -> Any:
+        tr = tracing.current_context()
+        if tr is not None:
+            kwargs["_trace"] = list(tr)
+        with self._lock:
+            self._req_id += 1
+            req_id = self._req_id
+            try:
+                if self._sock is None:
+                    self._sock = self._connect()
+                send_frame(self._sock, [req_id, method, kwargs])
+                resp = recv_frame(self._sock)
+            except (ConnectionError, OSError):
+                self.close()
+                raise
+        rid, status, payload = resp
+        if rid != req_id:
+            self.close()
+            raise ConnectionError(f"rpc response id mismatch: {rid} != {req_id}")
+        if status != 0:
+            raise RpcError(payload["error"], payload["message"])
+        return payload
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __enter__(self) -> "RpcClient":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

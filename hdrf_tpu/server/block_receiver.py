@@ -1,0 +1,266 @@
+"""Block write path: packet ingest, pipeline mirroring, reduction hook.
+
+Re-expression of BlockReceiver.java:
+
+- ``receive_direct``: the stock streaming path — packets forwarded to the
+  mirror as received (BlockReceiver.java:635-641 ``mirrorPacketTo``), written
+  to the local replica, per-packet acks upstream (PacketResponder,
+  BlockReceiver.java:1509).  The final empty packet's ack aggregates the
+  whole downstream chain (durability); earlier acks are flow control.
+- ``receive_reduced``: the reduction path.  The reference buffers the block
+  into a direct ByteBuffer ``bf1`` (BlockReceiver.java:877-897), acks, and
+  reduces asynchronously (DDRunner) — while every pipeline node re-runs
+  reduction on the raw stream independently.  Here DN1 buffers, reduces
+  ONCE, then ships the *reduced form* downstream ("reduced Block Mirroring",
+  the IEEE-paper capability missing from the reference snapshot; SURVEY.md §0
+  fact 3) and acks the last packet only after local commit + downstream ack.
+- Mirror-side ingest of the reduced form is ``ingest_reduced``: for dedup
+  schemes the mirror receives the ordered hash list, answers with the set of
+  chunks it lacks (one round trip), and receives exactly those bytes — the
+  "chunk index delta".
+
+Checksums: crc32c per ``checksum_chunk`` of the LOGICAL bytes are computed on
+ingest and stored in BlockMeta (the reference writes the checksum meta file
+even in reduction mode, BlockReceiver.java:924-986) so readers can verify
+end-to-end regardless of the stored form.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import TYPE_CHECKING
+
+from hdrf_tpu import native
+from hdrf_tpu.proto import datatransfer as dt
+from hdrf_tpu.proto.rpc import recv_frame, send_frame
+from hdrf_tpu.utils import fault_injection, metrics, tracing
+
+if TYPE_CHECKING:
+    from hdrf_tpu.server.datanode import DataNode
+
+_M = metrics.registry("block_receiver")
+_TR = tracing.tracer("datanode")
+
+
+def _checksums(data: bytes, chunk: int) -> list[int]:
+    return [int(c) for c in native.crc32c_chunks(data, chunk)]
+
+
+def _connect(addr: list | tuple) -> socket.socket:
+    s = socket.create_connection((addr[0], addr[1]), timeout=60)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+class BlockReceiver:
+    def __init__(self, dn: "DataNode"):
+        self._dn = dn
+
+    # ------------------------------------------------------------ direct path
+
+    def receive_direct(self, sock: socket.socket, fields: dict) -> None:
+        """Stock pipeline: stream packets to disk + mirror, ack per packet."""
+        dn = self._dn
+        block_id, gen_stamp = fields["block_id"], fields["gen_stamp"]
+        targets = fields.get("targets", [])
+        mirror_sock = None
+        with dn.direct_slot():  # bounded concurrent streaming writes
+            writer = dn.replicas.create_rbw(block_id, gen_stamp)
+            try:
+                if targets:
+                    mirror_sock = _connect(targets[0]["addr"])
+                    dt.send_op(mirror_sock, dt.WRITE_BLOCK,
+                               **{**fields, "targets": targets[1:]})
+                crcs: list[int] = []
+                tail = b""
+                cchunk = dn.checksum_chunk
+                forwarded = 0
+                for seqno, data, last in dt.iter_packets(sock):
+                    fault_injection.point("block_receiver.packet",
+                                          block_id=block_id, seqno=seqno)
+                    if mirror_sock is not None:
+                        dt.write_packet(mirror_sock, seqno, data, last)
+                        forwarded += 1
+                    if data:
+                        writer.write(data)
+                        tail += data
+                        while len(tail) >= cchunk:
+                            crcs.append(native.crc32c(tail[:cchunk]))
+                            tail = tail[cchunk:]
+                    if not last:
+                        dt.send_ack(sock, seqno)
+                    else:
+                        if tail:
+                            crcs.append(native.crc32c(tail))
+                        status = dt.ACK_SUCCESS
+                        if mirror_sock is not None:
+                            # Drain ALL mirror acks (one per forwarded packet);
+                            # the final one carries the aggregated downstream
+                            # status — earlier ones are flow control.
+                            for _ in range(forwarded):
+                                _, down = dt.read_ack(mirror_sock)
+                                status = max(status, down)
+                        meta = writer.finalize(writer.bytes_written, "direct",
+                                               crcs, cchunk)
+                        writer = None
+                        dn.notify_block_received(block_id, meta.logical_len)
+                        dt.send_ack(sock, seqno, status)
+                        _M.incr("blocks_received_direct")
+            finally:
+                if writer is not None:
+                    writer.abort()
+                if mirror_sock is not None:
+                    mirror_sock.close()
+
+    # ----------------------------------------------------------- reduced path
+
+    def receive_reduced(self, sock: socket.socket, fields: dict) -> None:
+        """Buffer the whole block (bf1 analog), reduce once, mirror the
+        reduced form, then send the final ack."""
+        dn = self._dn
+        block_id, gen_stamp = fields["block_id"], fields["gen_stamp"]
+        scheme_name = fields["scheme"]
+        targets = fields.get("targets", [])
+        parts: list[bytes] = []
+        last_seqno = 0
+        for seqno, data, last in dt.iter_packets(sock):
+            parts.append(data)
+            last_seqno = seqno
+            if not last:
+                dt.send_ack(sock, seqno)  # flow control; durability is the last ack
+        data = b"".join(parts)
+        with _TR.span("reduce_block",
+                      parent=tuple(fields["_trace"]) if fields.get("_trace") else None) as sp:
+            sp.annotate("block_id", block_id)
+            sp.annotate("scheme", scheme_name)
+            with dn.write_slot():  # admission control (DataXceiver.java:349-380)
+                status = self._store_and_mirror(block_id, gen_stamp, scheme_name,
+                                                data, targets)
+        dt.send_ack(sock, last_seqno, status)
+        _M.incr("blocks_received_reduced")
+
+    def _store_and_mirror(self, block_id: int, gen_stamp: int, scheme_name: str,
+                          data: bytes, targets: list) -> int:
+        dn = self._dn
+        scheme = dn.scheme(scheme_name)
+        crcs = _checksums(data, dn.checksum_chunk)
+        with metrics.registry("datanode").time("reduce_us"):
+            stored = scheme.reduce(block_id, data, dn.reduction_ctx)
+        writer = dn.replicas.create_rbw(block_id, gen_stamp)
+        try:
+            if stored:
+                writer.write(stored)
+            meta = writer.finalize(len(data), scheme_name, crcs, dn.checksum_chunk)
+        except Exception:
+            writer.abort()
+            raise
+        dn.notify_block_received(block_id, meta.logical_len)
+        status = dt.ACK_SUCCESS
+        if targets:
+            try:
+                self.push_reduced(block_id, gen_stamp, scheme_name, len(data),
+                                  stored, crcs, targets)
+            except (OSError, ConnectionError):
+                # Mirror failed; local copy is durable — the NN's redundancy
+                # monitor re-replicates (§3.5).  Matches pipeline-recovery
+                # semantics: report success for the local replica.
+                _M.incr("mirror_failures")
+        return status
+
+    # -------------------------------------------- reduced mirroring (push side)
+
+    def push_reduced(self, block_id: int, gen_stamp: int, scheme_name: str,
+                     logical_len: int, stored: bytes, crcs: list[int],
+                     targets: list) -> None:
+        """Ship the reduced form to targets[0], which relays to the rest.
+        Used by both pipeline mirroring and NN-commanded re-replication
+        (transferBlock, DataNode.java:2361 — which the reference serves by
+        reconstructing FULL bytes, §3.3 note)."""
+        dn = self._dn
+        scheme = dn.scheme(scheme_name)
+        mirror = _connect(targets[0]["addr"])
+        try:
+            if getattr(scheme, "container_codec", None) is not None:
+                # dedup family: hashes + need-list negotiation + chunk delta
+                entry = dn.index.get_block(block_id)
+                if entry is None:
+                    raise IOError(f"block {block_id} missing from chunk index")
+                dt.send_op(mirror, "write_reduced", block_id=block_id,
+                           gen_stamp=gen_stamp, scheme=scheme_name,
+                           logical_len=logical_len, checksums=crcs,
+                           checksum_chunk=dn.checksum_chunk,
+                           hashes=entry.hashes, targets=targets[1:])
+                need = recv_frame(mirror)["need"]  # indices into unique hash list
+                uniq = list(dict.fromkeys(entry.hashes))
+                needed_hashes = [uniq[i] for i in need]
+                locs = dn.index.lookup_chunks(needed_hashes)
+                chunk_locs = [(locs[h].container_id, locs[h].offset, locs[h].length)
+                              for h in needed_hashes]
+                chunks = dn.containers.read_chunks(chunk_locs)
+                seqno = 0
+                for chunk in chunks:
+                    dt.write_packet(mirror, seqno, chunk)
+                    seqno += 1
+                dt.write_packet(mirror, seqno, b"", last=True)
+                _, status = dt.read_ack(mirror)
+            else:
+                # direct/compress family: ship the stored bytes as-is
+                dt.send_op(mirror, "write_reduced", block_id=block_id,
+                           gen_stamp=gen_stamp, scheme=scheme_name,
+                           logical_len=logical_len, checksums=crcs,
+                           checksum_chunk=dn.checksum_chunk,
+                           hashes=None, targets=targets[1:])
+                recv_frame(mirror)  # symmetric need-frame (always empty here)
+                dt.stream_bytes(mirror, stored, dn.config.packet_size)
+                _, status = dt.read_ack(mirror)
+            if status != dt.ACK_SUCCESS:
+                raise IOError(f"mirror returned status {status}")
+            _M.incr("reduced_mirror_pushes")
+        finally:
+            mirror.close()
+
+    # ------------------------------------------- reduced mirroring (ingest side)
+
+    def ingest_reduced(self, sock: socket.socket, fields: dict) -> None:
+        """Mirror side of push_reduced: store the reduced form WITHOUT
+        re-running reduction (the whole point of reduced block mirroring)."""
+        dn = self._dn
+        block_id, gen_stamp = fields["block_id"], fields["gen_stamp"]
+        scheme_name, logical_len = fields["scheme"], fields["logical_len"]
+        crcs, cchunk = fields["checksums"], fields["checksum_chunk"]
+        hashes, targets = fields["hashes"], fields.get("targets", [])
+        stored = b""
+        if hashes is not None:
+            hashes = [bytes(h) for h in hashes]
+            uniq = list(dict.fromkeys(hashes))
+            known = dn.index.lookup_chunks(uniq)
+            need = [i for i, h in enumerate(uniq) if known[h] is None]
+            send_frame(sock, {"need": need})
+            chunks = [data for _, data, last in dt.iter_packets(sock) if data]
+            if len(chunks) != len(need):
+                raise IOError(f"expected {len(need)} chunks, got {len(chunks)}")
+            locs = dn.containers.append_chunks(chunks,
+                                               on_seal=dn.index.seal_container)
+            new_chunks = {uniq[i]: loc for i, loc in zip(need, locs)}
+            dn.index.commit_block(block_id, logical_len, hashes, new_chunks)
+        else:
+            send_frame(sock, {"need": []})
+            stored = dt.collect_packets(sock)
+        writer = dn.replicas.create_rbw(block_id, gen_stamp)
+        try:
+            if stored:
+                writer.write(stored)
+            meta = writer.finalize(logical_len, scheme_name, list(crcs), cchunk)
+        except Exception:
+            writer.abort()
+            raise
+        dn.notify_block_received(block_id, meta.logical_len)
+        status = dt.ACK_SUCCESS
+        if targets:  # relay down the chain
+            try:
+                self.push_reduced(block_id, gen_stamp, scheme_name, logical_len,
+                                  stored, list(crcs), targets)
+            except (OSError, ConnectionError):
+                _M.incr("mirror_failures")
+        dt.send_ack(sock, 0, status)
+        _M.incr("blocks_ingested_reduced")

@@ -1,0 +1,291 @@
+"""DataNode: the data plane daemon.
+
+Re-expression of the reference's DataNode stack — DataNode.java (daemon,
+3.7 kLoC), DataXceiverServer.java:44 (accept loop, thread per op),
+DataXceiver.java (op dispatch + admission control :313-380), BPServiceActor
+(heartbeats + block reports + NN command execution) — around the storage and
+reduction layers:
+
+- xceiver loop: thread-per-connection serving WRITE_BLOCK / READ_BLOCK /
+  write_reduced / TRANSFER_BLOCK / COPY_BLOCK / BLOCK_CHECKSUM
+  (Receiver.java:101-135 dispatch analog)
+- write ops route by scheme: ``direct`` -> streaming pipeline; everything
+  else -> buffered reduction with reduced block mirroring (block_receiver.py)
+- admission control: bounded semaphores per direction, replacing the
+  reference's racy static ticket queues (DataXceiver.java:130-133, the
+  sleep-loop waits at :313-380)
+- heartbeat thread executes NN commands: replicate (DNA_TRANSFER analog ->
+  reduced-form push, vs the reference's full-byte reconstruct-and-ship,
+  SURVEY.md §3.3 note) and invalidate (delete replica + release chunks)
+- block reports: full report on register + periodic; incremental (IBR) on
+  every finalize
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import socket
+import socketserver
+import threading
+import uuid
+from typing import Iterator
+
+from hdrf_tpu.config import DataNodeConfig
+from hdrf_tpu.index.chunk_index import ChunkIndex
+from hdrf_tpu.ops import dispatch as ops_dispatch
+from hdrf_tpu.proto import datatransfer as dt
+from hdrf_tpu.proto.rpc import RpcClient
+from hdrf_tpu.reduction import scheme as schemes
+from hdrf_tpu.reduction.scheme import ReductionContext, ReductionScheme
+from hdrf_tpu.server.block_receiver import BlockReceiver
+from hdrf_tpu.server.block_sender import BlockSender
+from hdrf_tpu.storage.container_store import ContainerStore
+from hdrf_tpu.storage.replica_store import ReplicaStore
+from hdrf_tpu.utils import fault_injection, metrics
+
+_M = metrics.registry("datanode")
+
+
+class DataNode:
+    def __init__(self, config: DataNodeConfig, namenode_addr: tuple[str, int],
+                 dn_id: str | None = None):
+        self.config = config
+        self.checksum_chunk = 64 * 1024
+        red = config.reduction
+        os.makedirs(config.data_dir, exist_ok=True)
+        self.replicas = ReplicaStore(os.path.join(config.data_dir, "replicas"))
+        self.containers = ContainerStore(
+            os.path.join(config.data_dir, "containers"),
+            container_size=red.container_size, codec=red.container_codec)
+        self.index = ChunkIndex(os.path.join(config.data_dir, "index"))
+        self.reduction_ctx = ReductionContext(
+            config=red, containers=self.containers, index=self.index,
+            backend=ops_dispatch.resolve_backend(red.backend))
+        # Admission control: bounded slots instead of ticket queues.
+        self._write_sem = threading.Semaphore(red.max_concurrent_writes)
+        self._read_sem = threading.Semaphore(red.max_concurrent_reads)
+        self._direct_sem = threading.Semaphore(red.max_concurrent_direct)
+        self.dn_id = dn_id or f"dn-{uuid.uuid4().hex[:8]}"
+        self._nn = RpcClient(namenode_addr)
+        self._receiver = BlockReceiver(self)
+        self._sender = BlockSender(self)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                outer._conns.add(self.request)
+                try:
+                    outer._xceive(self.request)
+                finally:
+                    outer._conns.discard(self.request)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((config.host, config.port), Handler)
+        self._conns: set[socket.socket] = set()
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._server.server_address
+
+    def start(self) -> "DataNode":
+        t = threading.Thread(target=self._server.serve_forever,
+                             name=f"{self.dn_id}-xceiver", daemon=True)
+        t.start()
+        self._threads.append(t)
+        self._register()
+        hb = threading.Thread(target=self._heartbeat_loop,
+                              name=f"{self.dn_id}-heartbeat", daemon=True)
+        hb.start()
+        self._threads.append(hb)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+        self._sever_connections()
+        for t in self._threads:
+            t.join(timeout=5)
+        self.containers.flush_open(on_seal=self.index.seal_container)
+        self.index.close()
+        self._nn.close()
+
+    def _sever_connections(self) -> None:
+        for s in list(self._conns):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            s.close()
+
+    # --------------------------------------------------------------- helpers
+
+    def scheme(self, name: str) -> ReductionScheme:
+        return schemes.get(name)
+
+    @contextlib.contextmanager
+    def write_slot(self) -> Iterator[None]:
+        if not self._write_sem.acquire(timeout=300):
+            raise TimeoutError("write admission timeout")
+        try:
+            yield
+        finally:
+            self._write_sem.release()
+
+    @contextlib.contextmanager
+    def direct_slot(self) -> Iterator[None]:
+        if not self._direct_sem.acquire(timeout=300):
+            raise TimeoutError("direct-write admission timeout")
+        try:
+            yield
+        finally:
+            self._direct_sem.release()
+
+    @contextlib.contextmanager
+    def read_slot(self) -> Iterator[None]:
+        if not self._read_sem.acquire(timeout=300):
+            raise TimeoutError("read admission timeout")
+        try:
+            yield
+        finally:
+            self._read_sem.release()
+
+    def notify_block_received(self, block_id: int, length: int) -> None:
+        """Incremental block report (IBR) on finalize; best-effort — the
+        periodic full report reconciles anything missed."""
+        try:
+            self._nn.call("block_received", dn_id=self.dn_id,
+                          block_id=block_id, length=length)
+        except (OSError, ConnectionError):
+            _M.incr("ibr_failures")
+
+    # ---------------------------------------------------------- xceiver loop
+
+    def _xceive(self, sock: socket.socket) -> None:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            op, fields = dt.recv_op(sock)
+        except (ConnectionError, OSError):
+            return
+        fault_injection.point("datanode.op", op=op)
+        try:
+            if op == dt.WRITE_BLOCK:
+                if fields["scheme"] == "direct":
+                    self._receiver.receive_direct(sock, fields)
+                else:
+                    self._receiver.receive_reduced(sock, fields)
+            elif op == "write_reduced":
+                self._receiver.ingest_reduced(sock, fields)
+            elif op == dt.READ_BLOCK:
+                self._sender.serve_read(sock, fields)
+            elif op == dt.BLOCK_CHECKSUM:
+                self._serve_checksum(sock, fields)
+            else:
+                _M.incr("unknown_ops")
+        except (ConnectionError, OSError):
+            _M.incr("op_io_errors")
+        except Exception:  # noqa: BLE001 — xceiver thread must not die silently
+            _M.incr("op_errors")
+        finally:
+            sock.close()
+
+    def _serve_checksum(self, sock: socket.socket, fields: dict) -> None:
+        from hdrf_tpu.proto.rpc import send_frame
+
+        meta = self.replicas.get_meta(fields["block_id"])
+        if meta is None:
+            send_frame(sock, {"status": 1, "error": "KeyError",
+                              "message": "no such block"})
+            return
+        send_frame(sock, {"status": 0, "checksum_chunk": meta.checksum_chunk,
+                          "checksums": meta.checksums,
+                          "logical_len": meta.logical_len})
+
+    # ------------------------------------------------------- NN interaction
+
+    def _register(self) -> None:
+        self._nn.call("register_datanode", dn_id=self.dn_id,
+                      addr=list(self.addr))
+        self._send_block_report()
+
+    def _send_block_report(self) -> None:
+        self._nn.call("block_report", dn_id=self.dn_id,
+                      blocks=[list(t) for t in self.replicas.block_report()])
+
+    def _heartbeat_loop(self) -> None:
+        interval = self.config.heartbeat_interval_s
+        last_report = 0.0
+        import time as _time
+
+        while not self._stop.wait(interval):
+            try:
+                fault_injection.point("datanode.heartbeat", dn_id=self.dn_id)
+                resp = self._nn.call("heartbeat", dn_id=self.dn_id,
+                                     stats=self._stats())
+                if resp.get("reregister"):
+                    self._register()
+                    continue
+                for cmd in resp.get("commands", []):
+                    self._execute(cmd)
+                now = _time.monotonic()
+                if now - last_report > self.config.block_report_interval_s:
+                    self._send_block_report()
+                    last_report = now
+            except (OSError, ConnectionError):
+                _M.incr("heartbeat_failures")
+            except Exception:  # noqa: BLE001
+                _M.incr("command_errors")
+
+    def _stats(self) -> dict:
+        return {
+            "blocks": len(self.replicas.block_ids()),
+            "logical_bytes": sum(m[2] for m in self.replicas.block_report()),
+            "physical_bytes": (self.replicas.physical_bytes()
+                               + self.containers.physical_bytes()),
+            "index": self.index.stats(),
+        }
+
+    def _execute(self, cmd: dict) -> None:
+        """NN command execution (BPServiceActor.processCommand analog)."""
+        if cmd["cmd"] == "invalidate":
+            for bid in cmd["block_ids"]:
+                self._invalidate(bid)
+        elif cmd["cmd"] == "replicate":
+            self._replicate(cmd)
+
+    def _invalidate(self, block_id: int) -> None:
+        meta = self.replicas.get_meta(block_id)
+        if meta is None:
+            return
+        self.scheme(meta.scheme).delete(block_id, self.reduction_ctx)
+        self.replicas.delete(block_id)
+        _M.incr("blocks_invalidated")
+
+    def _replicate(self, cmd: dict) -> None:
+        """DNA_TRANSFER: push our replica to targets, in reduced form
+        (vs the reference's reconstruct-full-bytes DataTransfer,
+        DataNode.java:2533)."""
+        block_id = cmd["block_id"]
+        meta = self.replicas.get_meta(block_id)
+        if meta is None:
+            return
+        stored = self.replicas.read_data(block_id) if meta.physical_len else b""
+        self._receiver.push_reduced(block_id, meta.gen_stamp, meta.scheme,
+                                    meta.logical_len, stored, meta.checksums,
+                                    cmd["targets"])
+        _M.incr("blocks_replicated")
+
+    # ------------------------------------------------------------ inspection
+
+    def run_directory_scan(self) -> list[str]:
+        """DirectoryScanner trigger (tests + admin)."""
+        return self.replicas.scan()

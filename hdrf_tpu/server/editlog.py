@@ -1,0 +1,105 @@
+"""NameNode persistence: edit log + fsimage checkpoints.
+
+Analog of the reference's FSEditLog (FSEditLog.java:124 — WAL of namespace
+mutations, group-committed) and FSImage (FSImage.java:85 — periodic protobuf
+snapshot; fsimage.proto).  Same durability discipline as the chunk index
+(hdrf_tpu/index/chunk_index.py): log-before-apply, seqno-idempotent replay so
+a crash between image publish and WAL truncation cannot double-apply, torn
+tails dropped via CRC framing (utils/wal.py).
+
+Checkpointing here is in-process (the SecondaryNameNode / StandbyCheckpointer
+roles collapse into one daemon; HA-style shared edits are out of scope for a
+single-NN deployment).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+import msgpack
+
+from hdrf_tpu.utils import fault_injection, wal as walmod
+
+WAL_NAME = "edits.wal"
+IMG_NAME = "fsimage"
+IMG_TMP = "fsimage.tmp"
+
+
+class EditLog:
+    def __init__(self, directory: str, checkpoint_every: int = 1000):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.seq = 0  # last seqno applied (image seq after load)
+        self._ops_since_ckpt = 0
+        self._checkpoint_every = checkpoint_every
+        self._snapshot_fn: Callable[[], Any] | None = None
+        self._wal = None  # opened after recovery
+
+    # -------------------------------------------------------------- recovery
+
+    def load_image(self) -> Any | None:
+        """Returns the fsimage snapshot (or None) and primes ``seq``."""
+        img = os.path.join(self._dir, IMG_NAME)
+        if not os.path.exists(img):
+            return None
+        with open(img, "rb") as f:
+            seq, snapshot = msgpack.unpackb(f.read(), raw=False, use_list=True,
+                                            strict_map_key=False)
+        self.seq = seq
+        return snapshot
+
+    def replay(self, apply_fn: Callable[[list], None]) -> int:
+        """Replay WAL records newer than the image; returns count applied.
+        Call once, after load_image, before open_for_append.  recover()
+        truncates any torn tail so open_for_append continues at the good
+        prefix (appending behind garbage would lose acked edits)."""
+        n = 0
+        for payload in walmod.recover(os.path.join(self._dir, WAL_NAME)):
+            seq, *rec = msgpack.unpackb(payload, raw=False, use_list=True,
+                                        strict_map_key=False)
+            if seq > self.seq:
+                apply_fn(rec)
+                self.seq = seq
+                n += 1
+        return n
+
+    def open_for_append(self, snapshot_fn: Callable[[], Any]) -> None:
+        """``snapshot_fn`` is called at auto-checkpoint time to capture the
+        current namespace state."""
+        self._snapshot_fn = snapshot_fn
+        self._wal = open(os.path.join(self._dir, WAL_NAME), "ab")
+
+    # --------------------------------------------------------------- logging
+
+    def append(self, rec: list) -> None:
+        """Durably log one mutation (logSync analog — every record is fsync'd;
+        the reference's group commit batching is future work)."""
+        payload = msgpack.packb([self.seq + 1, *rec])
+        fault_injection.point("editlog.append")
+        self._wal.write(walmod.frame(payload))
+        self._wal.flush()
+        os.fsync(self._wal.fileno())
+        self.seq += 1
+        self._ops_since_ckpt += 1
+        if self._ops_since_ckpt >= self._checkpoint_every:
+            self.checkpoint()
+
+    def checkpoint(self) -> None:
+        snapshot = self._snapshot_fn() if self._snapshot_fn else None
+        tmp = os.path.join(self._dir, IMG_TMP)
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb([self.seq, snapshot]))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self._dir, IMG_NAME))
+        fault_injection.point("editlog.post_checkpoint")
+        if self._wal is not None:
+            self._wal.truncate(0)
+            self._wal.seek(0)
+        self._ops_since_ckpt = 0
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None

@@ -1,0 +1,588 @@
+"""NameNode: the metadata plane.
+
+Re-expression of the reference's NameNode stack — FSNamesystem (namespace +
+lease manager, FSNamesystem.java, 8 kLoC), FSDirectory (INode tree),
+BlockManager (block->location map, replication scheduling,
+BlockManager.java:158), DatanodeManager + HeartbeatManager
+(HeartbeatManager.java:44 dead-node detection), NameNodeRpcServer — collapsed
+into one clean daemon with the same responsibilities:
+
+- namespace ops (mkdir/create/addBlock/complete/delete/rename/listing)
+- per-file **reduction scheme** attribute, chosen at create time: the explicit
+  policy that replaces the reference's hardcoded ``compressor`` static
+  (DataNode.java:438) and MapReduce-header sniffing (BlockReceiver.java:800-820)
+- lease management with expiry recovery (LeaseManager analog)
+- block map rebuilt from block reports; never persisted (HDFS invariant)
+- heartbeat-driven command delivery: replicate / invalidate
+  (DNA_TRANSFER / DNA_INVALIDATE, §3.5 of SURVEY.md)
+- durability via EditLog + fsimage (server/editlog.py)
+
+Locking: one namesystem lock (the reference's FSNamesystem global lock) —
+correct first, sharded later if metadata ops ever become the bottleneck.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from hdrf_tpu.config import NameNodeConfig
+from hdrf_tpu.proto.rpc import RpcServer
+from hdrf_tpu.server.editlog import EditLog
+from hdrf_tpu.utils import fault_injection, metrics
+
+_M = metrics.registry("namenode")
+
+
+@dataclass
+class FileNode:
+    replication: int
+    scheme: str
+    blocks: list[int] = field(default_factory=list)
+    complete: bool = False
+    mtime: float = 0.0
+
+
+@dataclass
+class BlockInfo:
+    block_id: int
+    gen_stamp: int
+    length: int  # logical; -1 until the client reports it at complete()
+    path: str
+    locations: set[str] = field(default_factory=set)  # dn_ids
+
+
+@dataclass
+class DatanodeInfo:
+    dn_id: str
+    addr: tuple[str, int]  # data-transfer endpoint
+    last_heartbeat: float = 0.0
+    blocks: set[int] = field(default_factory=set)
+    commands: list[dict] = field(default_factory=list)  # queued for next heartbeat
+    stats: dict = field(default_factory=dict)
+
+
+class LeaseManager:
+    """File-write leases (LeaseManager analog): one writer per file, renewed
+    by client heartbeat, expired leases recovered by the monitor."""
+
+    def __init__(self, expiry_s: float = 60.0):
+        self.expiry_s = expiry_s
+        self._leases: dict[str, tuple[str, float]] = {}  # path -> (client, deadline)
+
+    def acquire(self, path: str, client: str) -> None:
+        holder = self._leases.get(path)
+        now = time.monotonic()
+        if holder and holder[0] != client and holder[1] > now:
+            raise PermissionError(f"{path} leased by {holder[0]}")
+        self._leases[path] = (client, now + self.expiry_s)
+
+    def check(self, path: str, client: str) -> None:
+        holder = self._leases.get(path)
+        if holder is None or holder[0] != client:
+            raise PermissionError(f"{client} does not hold the lease on {path}")
+
+    def release(self, path: str, client: str) -> None:
+        self.check(path, client)
+        del self._leases[path]
+
+    def renew_all(self, client: str) -> None:
+        now = time.monotonic()
+        for path, (holder, _) in list(self._leases.items()):
+            if holder == client:
+                self._leases[path] = (client, now + self.expiry_s)
+
+    def expired(self) -> list[str]:
+        now = time.monotonic()
+        return [p for p, (_, dl) in self._leases.items() if dl <= now]
+
+    def drop(self, path: str) -> None:
+        self._leases.pop(path, None)
+
+
+class NameNode:
+    def __init__(self, config: NameNodeConfig | None = None):
+        self.config = config or NameNodeConfig()
+        self._lock = threading.RLock()  # the FSNamesystem lock analog
+        # namespace: nested dict tree; leaves are FileNode
+        self._root: dict[str, Any] = {}
+        self._blocks: dict[int, BlockInfo] = {}
+        self._datanodes: dict[str, DatanodeInfo] = {}
+        self._leases = LeaseManager()
+        self._next_block_id = 1
+        self._gen_stamp = 1
+        self._editlog = EditLog(self.config.meta_dir,
+                                self.config.editlog_checkpoint_every)
+        self._load()
+        self._rpc = RpcServer(self.config.host, self.config.port, self, "namenode")
+        self._monitor_stop = threading.Event()
+        self._monitor: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "NameNode":
+        self._rpc.start()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="nn-monitor", daemon=True)
+        self._monitor.start()
+        return self
+
+    def stop(self) -> None:
+        self._monitor_stop.set()
+        if self._monitor:
+            self._monitor.join(timeout=5)
+        self._rpc.stop()
+        self._editlog.close()
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._rpc.addr
+
+    # ---------------------------------------------------------- persistence
+
+    def _load(self) -> None:
+        snap = self._editlog.load_image()
+        if snap is not None:
+            self._restore(snap)
+        self._editlog.replay(self._apply)
+        self._editlog.open_for_append(self._snapshot)
+
+    def _snapshot(self) -> dict:
+        def walk(node: dict) -> dict:
+            out = {}
+            for name, child in node.items():
+                if isinstance(child, FileNode):
+                    out[name] = ["f", child.replication, child.scheme,
+                                 child.blocks, child.complete, child.mtime]
+                else:
+                    out[name] = ["d", walk(child)]
+            return out
+
+        return {
+            "tree": walk(self._root),
+            "blocks": {b.block_id: [b.gen_stamp, b.length, b.path]
+                       for b in self._blocks.values()},
+            "next_block_id": self._next_block_id,
+            "gen_stamp": self._gen_stamp,
+        }
+
+    def _restore(self, snap: dict) -> None:
+        def walk(m: dict) -> dict:
+            out: dict[str, Any] = {}
+            for name, v in m.items():
+                if v[0] == "f":
+                    out[name] = FileNode(v[1], v[2], list(v[3]), v[4], v[5])
+                else:
+                    out[name] = walk(v[1])
+            return out
+
+        self._root = walk(snap["tree"])
+        self._blocks = {bid: BlockInfo(bid, gs, ln, path)
+                        for bid, (gs, ln, path) in snap["blocks"].items()}
+        self._next_block_id = snap["next_block_id"]
+        self._gen_stamp = snap["gen_stamp"]
+
+    def _apply(self, rec: list) -> None:
+        """Apply one edit record (replay path and live path share this)."""
+        op = rec[0]
+        if op == "mkdir":
+            self._mkdir_apply(rec[1])
+        elif op == "create":
+            _, path, replication, scheme, mtime = rec
+            parent, name = self._parent_of(path, create=True)
+            parent[name] = FileNode(replication, scheme, mtime=mtime)
+        elif op == "add_block":
+            _, path, bid, gs = rec
+            node = self._file(path)
+            node.blocks.append(bid)
+            self._blocks[bid] = BlockInfo(bid, gs, -1, path)
+            self._next_block_id = max(self._next_block_id, bid + 1)
+            self._gen_stamp = max(self._gen_stamp, gs + 1)
+        elif op == "abandon_block":
+            _, path, bid = rec
+            node = self._file(path)
+            if bid in node.blocks:
+                node.blocks.remove(bid)
+            self._blocks.pop(bid, None)
+        elif op == "complete":
+            _, path, lengths, mtime = rec
+            node = self._file(path)
+            node.complete = True
+            node.mtime = mtime
+            for bid, ln in lengths.items():
+                if bid in self._blocks:
+                    self._blocks[bid].length = ln
+        elif op == "delete":
+            self._delete_apply(rec[1])
+        elif op == "rename":
+            self._rename_apply(rec[1], rec[2])
+
+    def _log(self, rec: list) -> None:
+        self._editlog.append(rec)
+        self._apply(rec)
+
+    # ------------------------------------------------------- tree utilities
+
+    @staticmethod
+    def _parts(path: str) -> list[str]:
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise ValueError("root path not allowed here")
+        return parts
+
+    def _parent_of(self, path: str, create: bool = False) -> tuple[dict, str]:
+        parts = self._parts(path)
+        node = self._root
+        for p in parts[:-1]:
+            child = node.get(p)
+            if child is None:
+                if not create:
+                    raise FileNotFoundError(f"parent of {path} does not exist")
+                child = node[p] = {}
+            if isinstance(child, FileNode):
+                raise NotADirectoryError(f"{p} in {path} is a file")
+            node = child
+        return node, parts[-1]
+
+    def _resolve(self, path: str) -> Any:
+        parts = [p for p in path.split("/") if p]
+        node: Any = self._root
+        for p in parts:
+            if isinstance(node, FileNode):
+                raise NotADirectoryError(path)
+            if p not in node:
+                raise FileNotFoundError(path)
+            node = node[p]
+        return node
+
+    def _file(self, path: str) -> FileNode:
+        node = self._resolve(path)
+        if not isinstance(node, FileNode):
+            raise IsADirectoryError(path)
+        return node
+
+    def _mkdir_apply(self, path: str) -> None:
+        node = self._root
+        for p in self._parts(path):
+            child = node.get(p)
+            if child is None:
+                child = node[p] = {}
+            if isinstance(child, FileNode):
+                raise FileExistsError(f"{path}: {p} is a file")
+            node = child
+
+    def _delete_apply(self, path: str) -> None:
+        parent, name = self._parent_of(path)
+        node = parent.pop(name, None)
+        for fn in self._iter_files(node):
+            for bid in fn.blocks:
+                info = self._blocks.pop(bid, None)
+                if info:
+                    for dn_id in info.locations:
+                        dn = self._datanodes.get(dn_id)
+                        if dn:
+                            dn.commands.append({"cmd": "invalidate",
+                                                "block_ids": [bid]})
+            self._leases.drop(path)
+
+    def _rename_apply(self, src: str, dst: str) -> None:
+        sparent, sname = self._parent_of(src)
+        node = sparent[sname]
+        dparent, dname = self._parent_of(dst, create=True)
+        if dname in dparent:
+            raise FileExistsError(dst)
+        del sparent[sname]
+        dparent[dname] = node
+        # fix block back-pointers
+        prefix_old, prefix_new = src.rstrip("/"), dst.rstrip("/")
+        for info in self._blocks.values():
+            if info.path == prefix_old or info.path.startswith(prefix_old + "/"):
+                info.path = prefix_new + info.path[len(prefix_old):]
+
+    @staticmethod
+    def _iter_files(node: Any):
+        if isinstance(node, FileNode):
+            yield node
+        elif isinstance(node, dict):
+            for child in node.values():
+                yield from NameNode._iter_files(child)
+
+    # ------------------------------------------------------ client RPC: fs ops
+
+    def rpc_mkdir(self, path: str) -> bool:
+        with self._lock:
+            self._log(["mkdir", path])
+            _M.incr("mkdir")
+            return True
+
+    def rpc_create(self, path: str, client: str, replication: int | None = None,
+                   scheme: str | None = None) -> dict:
+        with self._lock:
+            replication = replication or self.config.replication
+            scheme = scheme or "direct"
+            parent, name = self._parent_of(path, create=True)
+            existing = parent.get(name)
+            if existing is not None:
+                if isinstance(existing, dict):
+                    raise IsADirectoryError(path)
+                if existing.complete:
+                    raise FileExistsError(path)
+            self._leases.acquire(path, client)
+            if existing is not None:
+                # Overwriting an abandoned incomplete file: drop it first so
+                # its allocated blocks are invalidated on DNs rather than
+                # leaking in the block map forever.
+                self._log(["delete", path])
+            self._log(["create", path, replication, scheme, time.time()])
+            _M.incr("create")
+            return {"block_size": self.config.block_size, "scheme": scheme,
+                    "replication": replication}
+
+    def rpc_add_block(self, path: str, client: str) -> dict:
+        """Allocate the next block + choose target DNs (addBlock RPC ->
+        BlockManager placement, DataStreamer.java:1655's nextBlockOutputStream)."""
+        with self._lock:
+            self._leases.check(path, client)
+            node = self._file(path)
+            bid, gs = self._next_block_id, self._gen_stamp
+            targets = self._choose_targets(node.replication, exclude=set())
+            if not targets:
+                raise IOError("no datanodes available")
+            self._log(["add_block", path, bid, gs])
+            _M.incr("add_block")
+            return {"block_id": bid, "gen_stamp": gs, "scheme": node.scheme,
+                    "targets": [{"dn_id": d.dn_id, "addr": list(d.addr)}
+                                for d in targets]}
+
+    def rpc_abandon_block(self, path: str, client: str, block_id: int) -> bool:
+        with self._lock:
+            self._leases.check(path, client)
+            self._log(["abandon_block", path, block_id])
+            return True
+
+    def rpc_complete(self, path: str, client: str,
+                     block_lengths: dict[int, int]) -> bool:
+        with self._lock:
+            self._leases.check(path, client)
+            self._log(["complete", path, dict(block_lengths), time.time()])
+            self._leases.release(path, client)
+            _M.incr("complete")
+            return True
+
+    def rpc_renew_lease(self, client: str) -> bool:
+        with self._lock:
+            self._leases.renew_all(client)
+            return True
+
+    def rpc_get_block_locations(self, path: str) -> dict:
+        with self._lock:
+            node = self._file(path)
+            blocks = []
+            for bid in node.blocks:
+                info = self._blocks[bid]
+                locs = [{"dn_id": d, "addr": list(self._datanodes[d].addr)}
+                        for d in info.locations if d in self._datanodes]
+                blocks.append({"block_id": bid, "gen_stamp": info.gen_stamp,
+                               "length": info.length, "locations": locs})
+            _M.incr("get_block_locations")
+            return {"blocks": blocks, "scheme": node.scheme,
+                    "length": sum(max(b["length"], 0) for b in blocks),
+                    "complete": node.complete}
+
+    def rpc_delete(self, path: str) -> bool:
+        with self._lock:
+            try:
+                self._resolve(path)
+            except FileNotFoundError:
+                return False
+            self._log(["delete", path])
+            _M.incr("delete")
+            return True
+
+    def rpc_rename(self, src: str, dst: str) -> bool:
+        with self._lock:
+            self._resolve(src)
+            s = "/" + "/".join(self._parts(src))
+            d = "/" + "/".join(p for p in dst.split("/") if p)
+            if d == s or d.startswith(s + "/"):
+                raise ValueError(f"cannot rename {src} into its own subtree {dst}")
+            self._log(["rename", src, dst])
+            return True
+
+    def rpc_listing(self, path: str) -> list[dict]:
+        with self._lock:
+            node = self._resolve(path)
+            if isinstance(node, FileNode):
+                return [self._stat_entry(path.rstrip("/").rsplit("/", 1)[-1], node)]
+            return [self._stat_entry(name, child)
+                    for name, child in sorted(node.items())]
+
+    def rpc_stat(self, path: str) -> dict:
+        with self._lock:
+            node = self._resolve(path)
+            name = path.rstrip("/").rsplit("/", 1)[-1] or "/"
+            return self._stat_entry(name, node)
+
+    def _stat_entry(self, name: str, node: Any) -> dict:
+        if isinstance(node, FileNode):
+            length = sum(max(self._blocks[b].length, 0) for b in node.blocks
+                         if b in self._blocks)
+            return {"name": name, "type": "file", "length": length,
+                    "replication": node.replication, "scheme": node.scheme,
+                    "complete": node.complete, "blocks": len(node.blocks),
+                    "mtime": node.mtime}
+        return {"name": name, "type": "dir", "children": len(node)}
+
+    # --------------------------------------------------- datanode RPC: control
+
+    def rpc_register_datanode(self, dn_id: str, addr: list) -> dict:
+        with self._lock:
+            self._datanodes[dn_id] = DatanodeInfo(
+                dn_id, (addr[0], addr[1]), last_heartbeat=time.monotonic())
+            _M.incr("dn_registered")
+            return {"heartbeat_interval_s": self.config.heartbeat_interval_s}
+
+    def rpc_heartbeat(self, dn_id: str, stats: dict | None = None) -> dict:
+        with self._lock:
+            dn = self._datanodes.get(dn_id)
+            if dn is None:
+                return {"reregister": True, "commands": []}
+            dn.last_heartbeat = time.monotonic()
+            dn.stats = stats or {}
+            cmds, dn.commands = dn.commands, []
+            return {"reregister": False, "commands": cmds}
+
+    def rpc_block_report(self, dn_id: str, blocks: list) -> bool:
+        """Full report: authoritative sync of this DN's replica set
+        (BlockManager.processReport analog)."""
+        with self._lock:
+            dn = self._datanodes.get(dn_id)
+            if dn is None:
+                raise KeyError(f"unregistered datanode {dn_id}")
+            reported = set()
+            for bid, gs, length in blocks:
+                reported.add(bid)
+                info = self._blocks.get(bid)
+                if info is None:
+                    # replica for a deleted file: tell DN to drop it
+                    dn.commands.append({"cmd": "invalidate", "block_ids": [bid]})
+                    continue
+                info.locations.add(dn_id)
+                if info.length < 0:
+                    info.length = length
+            for bid in dn.blocks - reported:
+                info = self._blocks.get(bid)
+                if info:
+                    info.locations.discard(dn_id)
+            dn.blocks = reported
+            _M.incr("block_reports")
+            return True
+
+    def rpc_block_received(self, dn_id: str, block_id: int, length: int) -> bool:
+        """Incremental block report on pipeline finalize (IBR analog)."""
+        with self._lock:
+            dn = self._datanodes.get(dn_id)
+            info = self._blocks.get(block_id)
+            if dn is None or info is None:
+                return False
+            dn.blocks.add(block_id)
+            info.locations.add(dn_id)
+            if info.length < 0:
+                info.length = length
+            return True
+
+    # ------------------------------------------------------------- admin RPC
+
+    def rpc_datanode_report(self) -> list[dict]:
+        with self._lock:
+            now = time.monotonic()
+            return [{"dn_id": d.dn_id, "addr": list(d.addr),
+                     "alive": now - d.last_heartbeat < self.config.dead_node_interval_s,
+                     "blocks": len(d.blocks), "stats": d.stats}
+                    for d in self._datanodes.values()]
+
+    def rpc_save_namespace(self) -> bool:
+        with self._lock:
+            self._editlog.checkpoint()
+            return True
+
+    def rpc_metrics(self) -> dict:
+        return metrics.all_snapshots()
+
+    # ---------------------------------------------------------- block mgmt
+
+    def _choose_targets(self, n: int, exclude: set[str]) -> list[DatanodeInfo]:
+        """Placement: random spread over live DNs (BlockPlacementPolicyDefault's
+        rack-awareness collapses to uniform random without topology info)."""
+        now = time.monotonic()
+        live = [d for d in self._datanodes.values()
+                if now - d.last_heartbeat < self.config.dead_node_interval_s
+                and d.dn_id not in exclude]
+        random.shuffle(live)
+        return live[:n]
+
+    def _monitor_loop(self) -> None:
+        """HeartbeatManager.Monitor + RedundancyMonitor (§3.5): declare dead
+        DNs, schedule re-replication, recover expired leases."""
+        interval = self.config.heartbeat_interval_s
+        while not self._monitor_stop.wait(interval):
+            try:
+                fault_injection.point("namenode.monitor_tick")
+                self._check_dead_nodes()
+                self._check_replication()
+                self._recover_leases()
+            except Exception:  # noqa: BLE001 — monitor must survive
+                _M.incr("monitor_errors")
+
+    def _check_dead_nodes(self) -> None:
+        with self._lock:
+            now = time.monotonic()
+            for dn in list(self._datanodes.values()):
+                if now - dn.last_heartbeat > self.config.dead_node_interval_s:
+                    _M.incr("dn_declared_dead")
+                    for bid in dn.blocks:
+                        info = self._blocks.get(bid)
+                        if info:
+                            info.locations.discard(dn.dn_id)
+                    del self._datanodes[dn.dn_id]
+
+    def _check_replication(self) -> None:
+        with self._lock:
+            for info in self._blocks.values():
+                node = self._try_file(info.path)
+                if node is None or not node.complete:
+                    continue
+                live = {d for d in info.locations if d in self._datanodes}
+                deficit = node.replication - len(live)
+                if deficit > 0 and live:
+                    targets = self._choose_targets(deficit, exclude=live)
+                    if targets:
+                        src = self._datanodes[next(iter(live))]
+                        src.commands.append({
+                            "cmd": "replicate", "block_id": info.block_id,
+                            "gen_stamp": info.gen_stamp,
+                            "targets": [{"dn_id": t.dn_id, "addr": list(t.addr)}
+                                        for t in targets]})
+                        _M.incr("replications_scheduled")
+
+    def _recover_leases(self) -> None:
+        with self._lock:
+            for path in self._leases.expired():
+                self._leases.drop(path)
+                node = self._try_file(path)
+                if node is not None and not node.complete:
+                    # finalize with whatever lengths block reports gave us
+                    lengths = {b: max(self._blocks[b].length, 0)
+                               for b in node.blocks if b in self._blocks}
+                    self._log(["complete", path, lengths, time.time()])
+                    _M.incr("leases_recovered")
+
+    def _try_file(self, path: str) -> FileNode | None:
+        try:
+            node = self._resolve(path)
+            return node if isinstance(node, FileNode) else None
+        except (FileNotFoundError, NotADirectoryError):
+            return None

@@ -1,0 +1,147 @@
+"""End-to-end MiniCluster tests: the §3.1/§3.2 flagship paths, failure
+handling, and reduced block mirroring."""
+
+import os
+import random
+import time
+
+import pytest
+
+from hdrf_tpu.testing.minicluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_datanodes=3, replication=2, block_size=256 * 1024) as c:
+        yield c
+
+
+def blob(seed: int, n: int) -> bytes:
+    return random.Random(seed).randbytes(n)
+
+
+class TestEndToEnd:
+    def test_write_read_direct(self, cluster):
+        data = blob(1, 700_000)  # spans 3 blocks
+        with cluster.client() as c:
+            c.write("/e2e/direct", data, scheme="direct")
+            assert c.read("/e2e/direct") == data
+            st = c.stat("/e2e/direct")
+            assert st["length"] == len(data) and st["blocks"] == 3
+
+    @pytest.mark.parametrize("scheme", ["lz4", "zstd", "dedup_lz4"])
+    def test_write_read_reduced(self, cluster, scheme):
+        base = blob(2, 200_000)
+        data = base * 3 + blob(3, 100_000)  # dedup-friendly
+        with cluster.client() as c:
+            c.write(f"/e2e/{scheme}", data, scheme=scheme)
+            assert c.read(f"/e2e/{scheme}") == data
+
+    def test_range_reads(self, cluster):
+        data = blob(4, 600_000)
+        with cluster.client() as c:
+            c.write("/e2e/range", data, scheme="dedup_lz4")
+            for off, ln in [(0, 100), (255_000, 3000), (599_990, 10),
+                            (100_000, 400_000)]:
+                assert c.read("/e2e/range", off, ln) == data[off:off + ln]
+
+    def test_namespace_ops(self, cluster):
+        with cluster.client() as c:
+            c.mkdir("/ns/a")
+            c.write("/ns/a/f", b"hello", scheme="direct")
+            assert {e["name"] for e in c.ls("/ns/a")} == {"f"}
+            c.rename("/ns/a/f", "/ns/b/g")
+            assert c.read("/ns/b/g") == b"hello"
+            assert c.delete("/ns/b/g")
+            assert not c.exists("/ns/b/g")
+
+    def test_empty_file(self, cluster):
+        with cluster.client() as c:
+            c.write("/e2e/empty", b"", scheme="direct")
+            assert c.read("/e2e/empty") == b""
+
+    def test_dedup_across_files_saves_space(self):
+        # Dedicated 1-DN cluster: both files land on the same node, so the
+        # second file's chunks must all dedup against the first's.
+        with MiniCluster(n_datanodes=1, replication=1,
+                         block_size=256 * 1024) as cluster:
+            data = blob(5, 400_000)
+            with cluster.client() as c:
+                c.write("/dedup/one", data, scheme="dedup_lz4")
+                c.write("/dedup/two", data, scheme="dedup_lz4")
+                assert c.read("/dedup/two") == data
+            st = cluster.datanodes[0].index.stats()
+            assert st["logical_bytes"] == 2 * len(data)
+            assert st["unique_chunk_bytes"] <= len(data) + 70_000  # ~one copy
+
+
+class TestReducedMirroring:
+    def test_mirror_has_reduced_form_not_rerun(self, cluster):
+        """Replicas of a dedup'd block exist on 2 DNs with consistent logical
+        bytes served from both."""
+        data = blob(6, 300_000)
+        with cluster.client() as c:
+            c.write("/mirror/f", data, scheme="dedup_lz4", replication=2)
+            cluster.wait_for_replication("/mirror/f", 2)
+            loc = c._nn.call("get_block_locations", path="/mirror/f")
+            for b in loc["blocks"]:
+                assert len(b["locations"]) == 2
+                # read from EACH location directly
+                for l in b["locations"]:
+                    got = c._read_from(tuple(l["addr"]), b["block_id"], 0, -1)
+                    assert len(got) == b["length"]
+
+
+class TestFailure:
+    def test_read_failover_after_dn_death(self):
+        with MiniCluster(n_datanodes=3, replication=2,
+                         block_size=128 * 1024) as cluster:
+            data = blob(7, 300_000)
+            with cluster.client() as c:
+                c.write("/fail/f", data, scheme="lz4")
+                cluster.wait_for_replication("/fail/f", 2)
+                cluster.kill_datanode(0)
+                assert c.read("/fail/f") == data  # failover to live replica
+
+    def test_rereplication_after_dn_death(self):
+        with MiniCluster(n_datanodes=3, replication=2, block_size=128 * 1024,
+                         heartbeat_s=0.1, dead_node_s=0.5) as cluster:
+            data = blob(8, 200_000)
+            with cluster.client() as c:
+                c.write("/rerep/f", data, scheme="dedup_lz4")
+                cluster.wait_for_replication("/rerep/f", 2)
+                cluster.kill_datanode(0)
+                # monitor notices death, schedules re-replication to dn 2
+                cluster.wait_for_replication("/rerep/f", 2, timeout=20)
+                assert c.read("/rerep/f") == data
+
+    def test_datanode_restart_recovers_state(self):
+        with MiniCluster(n_datanodes=1, replication=1,
+                         block_size=128 * 1024) as cluster:
+            data = blob(9, 250_000)
+            with cluster.client() as c:
+                c.write("/restart/f", data, scheme="dedup_lz4")
+                cluster.stop_datanode(0)
+                cluster.restart_datanode(0)
+                cluster.wait_for_datanodes(1)
+                assert c.read("/restart/f") == data
+
+    def test_namenode_restart_recovers_namespace(self):
+        with MiniCluster(n_datanodes=1, replication=1,
+                         block_size=128 * 1024) as cluster:
+            data = blob(10, 150_000)
+            with cluster.client() as c:
+                c.write("/nnrestart/f", data, scheme="lz4")
+            cluster.restart_namenode()
+            # DN re-registers on next heartbeat (reregister flag)
+            cluster.wait_for_datanodes(1)
+            deadline = time.monotonic() + 10
+            with cluster.client() as c:
+                while time.monotonic() < deadline:
+                    try:
+                        assert c.read("/nnrestart/f") == data
+                        break
+                    except IOError:
+                        time.sleep(0.2)
+                else:
+                    pytest.fail("file unreadable after NN restart")

@@ -124,6 +124,26 @@ def test_torn_wal_tail_dropped(tmp_path):
     idx2.close()
 
 
+def test_append_after_torn_tail_survives_next_recovery(tmp_path):
+    # Regression: recovery must truncate the torn tail so post-crash appends
+    # don't land behind garbage (and vanish on the NEXT recovery).
+    idx = ChunkIndex(str(tmp_path))
+    idx.commit_block(1, 10, [h(1)], {h(1): (0, 0, 10)})
+    idx.commit_block(2, 20, [h(2)], {h(2): (0, 10, 20)})
+    idx.close()
+    wal = tmp_path / "index.wal"
+    wal.write_bytes(wal.read_bytes()[:-3])  # torn final record
+
+    idx2 = ChunkIndex(str(tmp_path))  # restart 1: replays block 1, drops 2
+    idx2.commit_block(3, 30, [h(3)], {h(3): (0, 30, 30)})  # post-crash append
+    idx2.close()
+
+    idx3 = ChunkIndex(str(tmp_path))  # restart 2: block 3 must survive
+    assert idx3.has_block(1) and idx3.has_block(3)
+    assert not idx3.has_block(2)
+    idx3.close()
+
+
 def test_corrupt_wal_record_stops_replay(tmp_path):
     idx = ChunkIndex(str(tmp_path))
     idx.commit_block(1, 10, [h(1)], {h(1): (0, 0, 10)})

@@ -1,0 +1,190 @@
+"""NameNode unit tests: namespace ops, leases, persistence, block management."""
+
+import pytest
+
+from hdrf_tpu.config import NameNodeConfig
+from hdrf_tpu.server.namenode import NameNode
+
+
+@pytest.fixture
+def nn(tmp_path):
+    cfg = NameNodeConfig(meta_dir=str(tmp_path / "name"), replication=2,
+                         block_size=1024, dead_node_interval_s=60.0)
+    n = NameNode(cfg)
+    # no .start(): RPC/monitor not needed for direct-call unit tests
+    yield n
+    n._editlog.close()
+
+
+def register(nn, n=3):
+    for i in range(n):
+        nn.rpc_register_datanode(f"dn-{i}", [f"h{i}", 1000 + i])
+
+
+class TestNamespace:
+    def test_mkdir_listing_stat(self, nn):
+        nn.rpc_mkdir("/a/b/c")
+        assert nn.rpc_listing("/a") == [{"name": "b", "type": "dir", "children": 1}]
+        assert nn.rpc_stat("/a/b/c") == {"name": "c", "type": "dir", "children": 0}
+
+    def test_create_write_flow(self, nn):
+        register(nn)
+        info = nn.rpc_create("/f", client="c1", scheme="dedup_lz4")
+        assert info["block_size"] == 1024 and info["scheme"] == "dedup_lz4"
+        alloc = nn.rpc_add_block("/f", client="c1")
+        assert len(alloc["targets"]) == 2  # replication
+        assert alloc["scheme"] == "dedup_lz4"
+        nn.rpc_complete("/f", client="c1", block_lengths={alloc["block_id"]: 500})
+        st = nn.rpc_stat("/f")
+        assert st["length"] == 500 and st["complete"]
+
+    def test_lease_enforcement(self, nn):
+        register(nn)
+        nn.rpc_create("/f", client="c1")
+        with pytest.raises(PermissionError):
+            nn.rpc_add_block("/f", client="c2")
+        with pytest.raises(PermissionError):
+            nn.rpc_create("/f", client="c2")  # lease held by c1
+
+    def test_lease_expiry_recovers_file(self, nn):
+        register(nn)
+        nn.rpc_create("/f", client="c1")
+        a = nn.rpc_add_block("/f", client="c1")
+        nn.rpc_block_received("dn-0", a["block_id"], 42)  # DN reported length
+        nn._leases.expiry_s = -1  # force expiry
+        nn._leases.renew_all("c1")
+        nn._recover_leases()
+        st = nn.rpc_stat("/f")
+        assert st["complete"] and st["length"] == 42  # recovered w/ reported len
+        with pytest.raises(FileExistsError):
+            nn.rpc_create("/f", client="c2")  # complete files aren't overwritten
+
+    def test_delete_and_rename(self, nn):
+        register(nn)
+        nn.rpc_create("/d/f", client="c1")
+        a = nn.rpc_add_block("/d/f", client="c1")
+        nn.rpc_complete("/d/f", client="c1", block_lengths={a["block_id"]: 10})
+        nn.rpc_rename("/d/f", "/d2/g")
+        assert nn.rpc_stat("/d2/g")["length"] == 10
+        assert nn._blocks[a["block_id"]].path == "/d2/g"
+        assert nn.rpc_delete("/d2/g")
+        assert a["block_id"] not in nn._blocks
+        assert not nn.rpc_delete("/d2/g")  # already gone
+
+    def test_rename_into_own_subtree_rejected(self, nn):
+        nn.rpc_mkdir("/a/b")
+        with pytest.raises(ValueError):
+            nn.rpc_rename("/a", "/a/b/c")
+        with pytest.raises(ValueError):
+            nn.rpc_rename("/a", "/a")
+        assert nn.rpc_stat("/a/b")["type"] == "dir"  # tree intact
+
+    def test_create_over_incomplete_invalidates_old_blocks(self, nn):
+        register(nn)
+        nn.rpc_create("/f", client="c1")
+        a = nn.rpc_add_block("/f", client="c1")
+        old_bid = a["block_id"]
+        nn.rpc_block_received(a["targets"][0]["dn_id"], old_bid, 10)
+        # c1 abandons; lease expires; c2 recreates the (incomplete) file
+        nn._leases.drop("/f")
+        nn.rpc_create("/f", client="c2")
+        assert old_bid not in nn._blocks  # no leak in the block map
+        cmds = nn.rpc_heartbeat(a["targets"][0]["dn_id"])["commands"]
+        assert {"cmd": "invalidate", "block_ids": [old_bid]} in cmds
+
+    def test_delete_queues_invalidation(self, nn):
+        register(nn)
+        nn.rpc_create("/f", client="c1")
+        a = nn.rpc_add_block("/f", client="c1")
+        bid = a["block_id"]
+        nn.rpc_complete("/f", client="c1", block_lengths={bid: 10})
+        dn0 = a["targets"][0]["dn_id"]
+        nn.rpc_block_received(dn0, bid, 10)
+        nn.rpc_delete("/f")
+        cmds = nn.rpc_heartbeat(dn0)["commands"]
+        assert {"cmd": "invalidate", "block_ids": [bid]} in cmds
+
+
+class TestPersistence:
+    def _flow(self, nn):
+        register(nn)
+        nn.rpc_mkdir("/dir")
+        nn.rpc_create("/dir/f", client="c1", scheme="lz4")
+        a = nn.rpc_add_block("/dir/f", client="c1")
+        nn.rpc_complete("/dir/f", client="c1", block_lengths={a["block_id"]: 77})
+        return a["block_id"]
+
+    def test_wal_replay(self, nn, tmp_path):
+        bid = self._flow(nn)
+        nn._editlog.close()
+        nn2 = NameNode(nn.config)
+        st = nn2.rpc_stat("/dir/f")
+        assert st["length"] == 77 and st["scheme"] == "lz4" and st["complete"]
+        assert nn2._blocks[bid].length == 77
+        assert nn2._next_block_id > bid
+        nn2._editlog.close()
+
+    def test_image_plus_wal(self, nn):
+        self._flow(nn)
+        nn.rpc_save_namespace()  # checkpoint
+        register(nn)
+        nn.rpc_create("/post", client="c1")
+        a2 = nn.rpc_add_block("/post", client="c1")
+        nn.rpc_complete("/post", client="c1", block_lengths={a2["block_id"]: 5})
+        nn._editlog.close()
+        nn2 = NameNode(nn.config)
+        assert nn2.rpc_stat("/dir/f")["length"] == 77
+        assert nn2.rpc_stat("/post")["length"] == 5
+        nn2._editlog.close()
+
+
+class TestBlockManagement:
+    def test_block_report_reconciles(self, nn):
+        register(nn, 1)
+        nn.rpc_create("/f", client="c1")
+        a = nn.rpc_add_block("/f", client="c1")
+        bid = a["block_id"]
+        nn.rpc_complete("/f", client="c1", block_lengths={bid: 9})
+        nn.rpc_block_report("dn-0", [[bid, a["gen_stamp"], 9]])
+        assert "dn-0" in nn._blocks[bid].locations
+        # stale replica of a deleted file -> invalidate command
+        nn.rpc_block_report("dn-0", [[bid, a["gen_stamp"], 9], [999, 1, 5]])
+        cmds = nn.rpc_heartbeat("dn-0")["commands"]
+        assert {"cmd": "invalidate", "block_ids": [999]} in cmds
+        # replica disappears from next report -> location removed
+        nn.rpc_block_report("dn-0", [])
+        assert "dn-0" not in nn._blocks[bid].locations
+
+    def test_replication_monitor_schedules(self, nn):
+        register(nn, 3)
+        nn.rpc_create("/f", client="c1", replication=3)
+        a = nn.rpc_add_block("/f", client="c1")
+        bid = a["block_id"]
+        nn.rpc_complete("/f", client="c1", block_lengths={bid: 9})
+        nn.rpc_block_received("dn-0", bid, 9)  # only 1 of 3 replicas
+        nn._check_replication()
+        cmds = nn.rpc_heartbeat("dn-0")["commands"]
+        rep = [c for c in cmds if c["cmd"] == "replicate"]
+        assert len(rep) == 1 and rep[0]["block_id"] == bid
+        assert len(rep[0]["targets"]) == 2
+        assert all(t["dn_id"] != "dn-0" for t in rep[0]["targets"])
+
+    def test_dead_node_detection(self, nn):
+        register(nn, 2)
+        nn.rpc_create("/f", client="c1")
+        a = nn.rpc_add_block("/f", client="c1")
+        bid = a["block_id"]
+        nn.rpc_complete("/f", client="c1", block_lengths={bid: 9})
+        nn.rpc_block_received("dn-0", bid, 9)
+        nn.config.dead_node_interval_s = -1  # everything is dead
+        nn._check_dead_nodes()
+        assert nn._datanodes == {}
+        assert nn._blocks[bid].locations == set()
+
+    def test_heartbeat_unknown_dn_asks_reregister(self, nn):
+        assert nn.rpc_heartbeat("ghost")["reregister"]
+
+    def test_add_block_no_datanodes(self, nn):
+        nn.rpc_create("/f", client="c1")
+        with pytest.raises(IOError):
+            nn.rpc_add_block("/f", client="c1")

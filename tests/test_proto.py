@@ -1,0 +1,128 @@
+"""Control RPC + data-transfer framing."""
+
+import socket
+import threading
+
+import pytest
+
+from hdrf_tpu.proto import datatransfer as dt
+from hdrf_tpu.proto.rpc import RpcClient, RpcError, RpcServer
+
+
+class EchoService:
+    def rpc_add(self, a, b):
+        return a + b
+
+    def rpc_boom(self):
+        raise ValueError("kapow")
+
+    def rpc_echo(self, **kw):
+        return kw
+
+
+@pytest.fixture
+def server():
+    srv = RpcServer("127.0.0.1", 0, EchoService(), "test").start()
+    yield srv
+    srv.stop()
+
+
+class TestRpc:
+    def test_roundtrip(self, server):
+        with RpcClient(server.addr) as c:
+            assert c.call("add", a=2, b=3) == 5
+
+    def test_error_roundtrip(self, server):
+        with RpcClient(server.addr) as c:
+            with pytest.raises(RpcError) as ei:
+                c.call("boom")
+            assert ei.value.error == "ValueError" and "kapow" in ei.value.message
+
+    def test_unknown_method(self, server):
+        with RpcClient(server.addr) as c:
+            with pytest.raises(RpcError) as ei:
+                c.call("nope")
+            assert ei.value.error == "NoSuchMethod"
+
+    def test_binary_and_nested_payloads(self, server):
+        with RpcClient(server.addr) as c:
+            out = c.call("echo", blob=b"\x00\xff" * 100, nested={"a": [1, 2]})
+            assert out["blob"] == b"\x00\xff" * 100
+            assert out["nested"] == {"a": [1, 2]}
+
+    def test_concurrent_clients(self, server):
+        errs = []
+
+        def worker(n):
+            try:
+                with RpcClient(server.addr) as c:
+                    for i in range(50):
+                        assert c.call("add", a=n, b=i) == n + i
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(n,)) for n in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+
+    def test_reconnect_after_server_restart(self, server):
+        c = RpcClient(server.addr)
+        assert c.call("add", a=1, b=1) == 2
+        c._sock.close()  # simulate broken connection
+        with pytest.raises((ConnectionError, OSError)):
+            c.call("add", a=1, b=1)
+        assert c.call("add", a=2, b=2) == 4  # auto-reconnect on next call
+        c.close()
+
+
+class TestDataTransfer:
+    def _pair(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        cli = socket.create_connection(srv.getsockname())
+        conn, _ = srv.accept()
+        srv.close()
+        return cli, conn
+
+    def test_packet_roundtrip(self):
+        a, b = self._pair()
+        dt.write_packet(a, 7, b"hello", last=False)
+        dt.write_packet(a, 8, b"", last=True)
+        assert dt.read_packet(b) == (7, b"hello", False)
+        assert dt.read_packet(b) == (8, b"", True)
+        a.close(), b.close()
+
+    def test_checksum_detects_corruption(self):
+        a, b = self._pair()
+        hdr = dt.PKT_HDR.pack(5, 1, 0, 12345)  # wrong crc
+        a.sendall(hdr + b"hello")
+        with pytest.raises(IOError, match="checksum"):
+            dt.read_packet(b)
+        a.close(), b.close()
+
+    def test_stream_and_collect(self):
+        a, b = self._pair()
+        data = bytes(range(256)) * 1000
+        n = dt.stream_bytes(a, data, packet_size=4096)
+        assert n == len(data) // 4096 + 1 + (1 if len(data) % 4096 else 0) - 1 or n > 0
+        assert dt.collect_packets(b) == data
+        a.close(), b.close()
+
+    def test_op_header_roundtrip(self):
+        a, b = self._pair()
+        dt.send_op(a, dt.WRITE_BLOCK, block_id=5, targets=[{"addr": ["h", 1]}])
+        op, fields = dt.recv_op(b)
+        assert op == dt.WRITE_BLOCK and fields["block_id"] == 5
+        a.close(), b.close()
+
+    def test_acks(self):
+        a, b = self._pair()
+        dt.send_ack(a, 42, dt.ACK_SUCCESS)
+        dt.send_ack(a, 43, dt.ACK_ERROR)
+        assert dt.read_ack(b) == (42, dt.ACK_SUCCESS)
+        assert dt.read_ack(b) == (43, dt.ACK_ERROR)
+        a.close(), b.close()
