@@ -82,6 +82,9 @@ class NameNodeConfig:
     # Heartbeat bookkeeping (HeartbeatManager.java:44).
     heartbeat_interval_s: float = 1.0
     dead_node_interval_s: float = 6.0
+    # How long a scheduled re-replication may stay in flight before the
+    # monitor re-queues it (PendingReconstructionBlocks timeout analog).
+    pending_replication_timeout_s: float = 30.0
     editlog_checkpoint_every: int = 1000  # ops between auto-checkpoints
 
 

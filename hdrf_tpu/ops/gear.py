@@ -82,6 +82,38 @@ def _doubling_hashes(t: jax.Array) -> jax.Array:
 _PACK_ROW = 256  # mask bits packed per matmul row -> 32 output bytes
 
 
+def candidate_bitmap_words(block_u8: jax.Array, mask: jax.Array,
+                           pos1_base: jax.Array | None = None) -> jax.Array:
+    """Packed all-position candidate bitmap of a resident block.
+
+    The one implementation of the gear-scan hot path, shared by the
+    single-chip scan (_candidate_words), the device-resident pipeline
+    (ops/resident._prep), the seq-sharded scan (parallel/sharded), and the
+    graft entry.  block_u8: u8[n], n % _PACK_ROW == 0.  ``pos1_base`` offsets
+    the 1-based positions for shards of a larger block (uint32 scalar).
+    Returns u32[n/32] little-endian bitmap words (bit k of word w = position
+    32w + k is a candidate cut *end*, i.e. cut-point = bit index + 1).
+    """
+    n = block_u8.shape[0]
+    t = _gear_map(block_u8)
+    h = _doubling_hashes(t)
+    pos1 = jnp.arange(1, n + 1, dtype=jnp.uint32)
+    if pos1_base is not None:
+        pos1 = pos1 + pos1_base
+    is_cand = ((h & mask) == 0) & (pos1 >= WINDOW)
+    return pack_bitmap_words(is_cand)
+
+
+def pack_bitmap_words(is_cand: jax.Array) -> jax.Array:
+    """bool[n] -> little-endian u32[n/32] bitmap via the MXU pack matmul
+    (exact in f32: per-byte bit sums stay < 2^8).  n % _PACK_ROW == 0."""
+    m = is_cand.astype(jnp.float32).reshape(-1, _PACK_ROW)
+    packed = jnp.dot(m, jnp.asarray(_pack_weights()),
+                     preferred_element_type=jnp.float32)
+    b = packed.astype(jnp.uint32).reshape(-1, 4)
+    return b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
+
+
 @functools.cache
 def _pack_weights() -> np.ndarray:
     """Block-diagonal (256, 32) f32: output byte j sums bits 8j..8j+7 weighted
@@ -104,17 +136,8 @@ def _candidate_words(block: jax.Array, mask: jax.Array, cap: int):
     densities). D2H is O(candidates): word indices + word values + count.
     """
     n = block.shape[0]
-    t = _gear_map(block)
-    h = _doubling_hashes(t)
-    pos1 = jnp.arange(1, n + 1, dtype=jnp.uint32)
-    is_cand = ((h & mask) == 0) & (pos1 >= WINDOW)
     pad = (-n) % _PACK_ROW
-    m = jnp.pad(is_cand, (0, pad)).astype(jnp.float32).reshape(-1, _PACK_ROW)
-    packed = jnp.dot(m, jnp.asarray(_pack_weights()),
-                     preferred_element_type=jnp.float32)
-    bytes_ = packed.astype(jnp.uint32).reshape(-1, 4)  # little-endian groups of 4
-    words = (bytes_[:, 0] | (bytes_[:, 1] << 8) | (bytes_[:, 2] << 16)
-             | (bytes_[:, 3] << 24))
+    words = candidate_bitmap_words(jnp.pad(block, (0, pad)), mask)
     nz = words != 0
     (idx,) = jnp.nonzero(nz, size=cap, fill_value=words.shape[0])
     vals = jnp.take(words, idx, fill_value=0)

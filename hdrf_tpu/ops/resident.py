@@ -1,0 +1,243 @@
+"""Device-resident block reduction pipeline.
+
+The naive composition (ops.gear then ops.sha256) moves the block host->device
+for the CDC scan, back to the host, and *again* to the device as padded SHA
+lane buffers — ~2.2x the block over the wire.  On the PCIe/tunnel path that
+transfer dominates end-to-end throughput (PERF_NOTES.md); the reference has
+the same structural flaw in CPU terms: DataDeduplicator.java re-walks the
+block once per stage (chunking :264-307, then hashing :536-650, then storing
+:652-845) from Java heap buffers.
+
+This pipeline crosses the block to HBM **once** and keeps every per-byte pass
+on device:
+
+1. ``_prep`` (one dispatch): big-endian u32 word image + all-position Gear
+   candidate scan; only the sparse candidate words come back (O(chunks)).
+2. Host: min/max cut selection over sparse candidates (native C++), chunk
+   bucketing — O(chunks) control work.
+3. ``_bucket_sha`` (one dispatch per size bucket): lanes are *gathered on
+   device* from the resident word image (vmapped dynamic_slice = Mosaic DMAs),
+   byte-aligned with a VPU funnel shift (chunk offsets are arbitrary bytes;
+   the gather is word-granular), SHA-padded in word space, and hashed by the
+   lane-parallel compression scan (ops.sha256.sha256_words).  Only digests
+   come back.
+
+Host<->device traffic per 64 MiB block: 64 MiB H2D + ~100 KiB of offsets
+down, ~250 KiB of candidates+digests up.  All readbacks are started with
+``copy_to_host_async`` so a caller that overlaps blocks (submit k+1 before
+finishing k) hides dispatch and D2H latency entirely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hdrf_tpu.config import CdcConfig
+from hdrf_tpu.ops import gear
+from hdrf_tpu.ops.dispatch import gear_mask
+from hdrf_tpu.ops.sha256 import sha256_words
+
+
+def _bucket_of(nb: int) -> int:
+    """Bucket = next power of two of the padded SHA block count (<=2x waste)."""
+    return 1 << int(nb - 1).bit_length()
+
+
+def _lane_count(n: int) -> int:
+    if n <= 128:
+        return 128
+    return 1 << int(n - 1).bit_length()
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "pad_words"))
+def _prep(block: jax.Array, mask: jax.Array, cap: int, pad_words: int):
+    """One pass over the resident block: BE word image + candidate scan.
+
+    Returns (words u32[N/4 + pad_words], cand i32[1 + 2*cap]) where cand
+    packs [count, word_idx..., word_val...] into a single D2H transfer.
+    """
+    b4 = block.reshape(-1, 4).astype(jnp.uint32)
+    words = (b4[:, 0] << 24) | (b4[:, 1] << 16) | (b4[:, 2] << 8) | b4[:, 3]
+    words = jnp.concatenate([words, jnp.zeros(pad_words, jnp.uint32)])
+
+    cw = gear.candidate_bitmap_words(block, mask)
+    nz = cw != 0
+    (idx,) = jnp.nonzero(nz, size=cap, fill_value=cw.shape[0])
+    vals = jnp.take(cw, idx, fill_value=0)
+    count = jnp.sum(nz.astype(jnp.int32))
+    cand = jnp.concatenate([count[None], idx.astype(jnp.int32),
+                            jax.lax.bitcast_convert_type(vals, jnp.int32)])
+    return words, cand
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def _bucket_sha(words: jax.Array, offs: jax.Array, lens: jax.Array,
+                bucket: int) -> jax.Array:
+    """Gather + byte-align + SHA-pad + hash one size bucket of chunks.
+
+    words: u32[NW] resident BE word image (zero-padded so no slice clamps).
+    offs:  i32[L] chunk byte offsets; lens: i32[L] chunk byte lengths,
+    lens + 9 <= bucket * 64.  Returns u8[L, 32].
+    """
+    W = bucket * 16  # u32 words per lane
+    q = offs // 4
+    s8 = ((offs % 4) * 8).astype(jnp.uint32)[:, None]
+
+    lanes = jax.vmap(lambda o: jax.lax.dynamic_slice(words, (o,), (W + 1,)))(q)
+    a, b = lanes[:, :W], lanes[:, 1:]
+    # Funnel shift: byte-misaligned chunk words from two adjacent aligned words.
+    c = jnp.where(s8 == 0, a, (a << s8) | (b >> (jnp.uint32(32) - s8)))
+
+    # SHA padding in word space: keep data words, splice 0x80 at byte ``len``,
+    # zero the tail, write the 64-bit big-endian bit length in the last words.
+    wl = (lens // 4)[:, None]
+    r8 = ((lens % 4) * 8).astype(jnp.uint32)[:, None]
+    j = jnp.arange(W, dtype=jnp.int32)[None, :]
+    keep = jnp.where(r8 == 0, jnp.uint32(0),
+                     jnp.uint32(0xFFFFFFFF) << (jnp.uint32(32) - r8))
+    marker = jnp.uint32(0x80) << (jnp.uint32(24) - r8)
+    boundary = (c & keep) | marker
+    out = jnp.where(j < wl, c, jnp.where(j == wl, boundary, jnp.uint32(0)))
+    nb = (lens + 9 + 63) // 64
+    last = nb * 16 - 1
+    bitlen = (lens.astype(jnp.uint32) * 8)[:, None]
+    out = jnp.where(j == last[:, None], bitlen, out)
+    return sha256_words(out, nb.astype(jnp.int32))
+
+
+@dataclasses.dataclass
+class BlockJob:
+    n: int
+    block: jax.Array | None   # resident u8 image (until cuts are final)
+    words: jax.Array          # resident BE word image
+    cand: jax.Array           # packed candidate readback (D2H in flight)
+    cap: int
+    cuts: np.ndarray | None = None
+    _sha_parts: tuple | None = None  # (sels, lane_counts, digests_dev)
+
+
+class ResidentReducer:
+    """Async block-reduction front end over the device-resident pipeline.
+
+    Usage (overlapped):
+        jobs = [r.submit(b) for b in blocks]      # H2D + scan dispatches
+        for j in jobs: r.start_sha(j)             # cut select + SHA dispatches
+        results = [r.finish(j) for j in jobs]     # (cuts, digests)
+    """
+
+    def __init__(self, cdc: CdcConfig | None = None):
+        self.cdc = cdc or CdcConfig()
+        self.mask = gear_mask(self.cdc)
+        # Gather windows must never clamp: pad the word image by the widest
+        # bucket (max_chunk rounded up) + the funnel-shift lookahead word.
+        max_nb = (self.cdc.max_chunk + 9 + 63) // 64
+        self.pad_words = _bucket_of(max_nb) * 16 + 16
+
+    def submit(self, data: bytes | np.ndarray | jax.Array,
+               n: int | None = None) -> BlockJob:
+        """Start reduction of one block.  ``data`` may be host bytes or an
+        already-HBM-resident u8 device array (the gRPC-streamed TPU-worker
+        deployment lands packets in HBM before reduction starts; ``n`` gives
+        the true length when the device array carries pad)."""
+        if isinstance(data, jax.Array):
+            block, n = data, n if n is not None else data.shape[0]
+            if block.shape[0] % gear._PACK_ROW:
+                block = jnp.pad(
+                    block,
+                    (0, gear._PACK_ROW - block.shape[0] % gear._PACK_ROW))
+        else:
+            a = (np.frombuffer(data, dtype=np.uint8)
+                 if not isinstance(data, np.ndarray) else data)
+            n = a.size
+            if n % gear._PACK_ROW:  # pad to the bitmap pack grid; candidates
+                # in the zero tail are filtered by _words_to_positions
+                a = np.concatenate(
+                    [a, np.zeros(gear._PACK_ROW - n % gear._PACK_ROW,
+                                 np.uint8)])
+            block = jax.device_put(a)
+        if n == 0:
+            job = BlockJob(n=0, block=None, words=None, cand=None, cap=0,
+                           cuts=np.empty(0, dtype=np.uint64))
+            job._sha_parts = ([], [], None)
+            return job
+        cap = max(1, min(block.shape[0] // 32,
+                         max(1024, (n >> max(self.cdc.mask_bits - 1, 0)) + 1024)))
+        words, cand = _prep(block, jnp.uint32(self.mask), cap, self.pad_words)
+        cand.copy_to_host_async()
+        return BlockJob(n=n, block=block, words=words, cand=cand, cap=cap)
+
+    def start_sha(self, job: BlockJob) -> None:
+        if job.cand is None:  # empty block prepared entirely in submit()
+            return
+        cand = np.asarray(job.cand)
+        count, cap = int(cand[0]), job.cap
+        if count > cap:
+            # Dense candidates (long zero/constant runs hash to 0, making
+            # every position a candidate): one retry with exact capacity.
+            cap = count
+            _, cand_dev = _prep(job.block, jnp.uint32(self.mask), cap,
+                                self.pad_words)
+            cand = np.asarray(cand_dev)
+            count = int(cand[0])
+        idx = cand[1:1 + count].astype(np.uint32)
+        vals = cand[1 + cap:1 + cap + count].view(np.uint32)
+        pos = gear._words_to_positions(idx, vals, job.n)
+        from hdrf_tpu import native
+
+        cuts = native.cdc_select(pos, job.n, self.cdc.min_chunk,
+                                 self.cdc.max_chunk)
+        job.cuts = cuts
+        starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
+        lens = (cuts - starts).astype(np.int64)
+        nb = (lens + 9 + 63) // 64
+        sels, parts = [], []
+        order = np.arange(len(cuts))
+        done = np.zeros(len(cuts), dtype=bool)
+        B = 1
+        while not done.all():
+            sel = order[(nb <= B) & ~done]
+            if sel.size:
+                done[sel] = True
+                L = _lane_count(sel.size)
+                offs_b = np.zeros(L, dtype=np.int32)
+                lens_b = np.zeros(L, dtype=np.int32)
+                offs_b[:sel.size] = starts[sel]
+                lens_b[:sel.size] = lens[sel]
+                parts.append(_bucket_sha(job.words, jax.device_put(offs_b),
+                                         jax.device_put(lens_b), B))
+                sels.append(sel)
+            B *= 2
+        # One device-side concat -> ONE digest readback (each extra D2H costs
+        # a fixed ~100 ms round trip on the tunneled transport).
+        if parts:
+            alld = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+            alld.copy_to_host_async()
+        else:  # empty block: no chunks, no digests
+            alld = None
+        job._sha_parts = (sels, [p.shape[0] for p in parts], alld)
+        job.block = None  # cuts are final; release the u8 image
+
+    def finish(self, job: BlockJob) -> tuple[np.ndarray, np.ndarray]:
+        if job._sha_parts is None:
+            self.start_sha(job)
+        sels, lane_counts, digs_dev = job._sha_parts
+        out = np.empty((len(job.cuts), 32), dtype=np.uint8)
+        if digs_dev is not None:
+            digs = np.asarray(digs_dev)
+            at = 0
+            for sel, L in zip(sels, lane_counts):
+                out[sel] = digs[at:at + sel.size]
+                at += L
+        job.words = None  # release the HBM word image
+        return job.cuts, out
+
+    def reduce(self, data: bytes | np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Synchronous single-block convenience: (cuts, digests)."""
+        job = self.submit(data)
+        self.start_sha(job)
+        return self.finish(job)

@@ -42,60 +42,103 @@ def _rotr(x: jax.Array, n: int) -> jax.Array:
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
-def _compress(state: list[jax.Array], w: list[jax.Array]) -> list[jax.Array]:
+def _compress(state: list[jax.Array], blk: jax.Array) -> list[jax.Array]:
     """One SHA-256 compression for every lane.
 
-    state: 8 arrays u32[R, 128]; w: 16 arrays u32[R, 128] (big-endian words).
+    state: 8 arrays u32[R, 128]; blk: u32[16, R, 128] (big-endian words).
     Lanes live as (R, 128) tiles — the natural VPU layout; a flat (L,) vector
     wastes sublanes and measured ~5x slower.
+
+    Both the message-schedule extension and the 64 rounds are ``lax.scan``s
+    with partial unroll, NOT fully unrolled Python loops: a fully unrolled
+    compression whose output feeds the Davies-Meyer add (``state + rounds``)
+    sends XLA:CPU's LLVM pipeline into a multi-minute compile (the closing
+    live range over 64 unrolled rounds; reproduced and bisected 2026-07-30).
     """
-    w = list(w)
-    for i in range(16, 64):
-        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> np.uint32(3))
-        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> np.uint32(10))
-        w.append(w[i - 16] + s0 + w[i - 7] + s1)
-    a, b, c, d, e, f, g, h = state
-    for i in range(64):
+
+    # TPU's Mosaic/LLVM pipeline handles the fully unrolled graph fine (and
+    # the scan loop overhead costs real throughput there); only XLA:CPU needs
+    # the partial unroll.
+    unroll = 8 if jax.default_backend() == "cpu" else 64
+
+    def extend(carry, _):
+        # carry: u32[16, R, 128] — the sliding window w[i-16..i-1]
+        s0 = (_rotr(carry[1], 7) ^ _rotr(carry[1], 18)
+              ^ (carry[1] >> np.uint32(3)))
+        s1 = (_rotr(carry[14], 17) ^ _rotr(carry[14], 19)
+              ^ (carry[14] >> np.uint32(10)))
+        nxt = carry[0] + s0 + carry[9] + s1
+        return jnp.concatenate([carry[1:], nxt[None]]), nxt
+
+    _, w_ext = jax.lax.scan(extend, blk, None, length=48,
+                            unroll=min(unroll, 48))
+    w_all = jnp.concatenate([blk, w_ext])  # u32[64, R, 128]
+
+    def round_(carry, xs):
+        a, b, c, d, e, f, g, h = carry
+        k, w = xs
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + np.uint32(_K[i]) + w[i]
+        t1 = h + s1 + ch + k + w
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
-        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + s0 + maj
-    return [s + v for s, v in zip(state, [a, b, c, d, e, f, g, h])]
+        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g), None
+
+    out, _ = jax.lax.scan(round_, tuple(state), (jnp.asarray(_K), w_all),
+                          unroll=unroll)
+    return [s + v for s, v in zip(state, out)]
 
 
 @jax.jit
-def sha256_lanes(blocks_u8: jax.Array, nblocks: jax.Array) -> jax.Array:
-    """SHA-256 of L pre-padded messages in parallel.
+def sha256_words(words: jax.Array, nblocks: jax.Array) -> jax.Array:
+    """SHA-256 of L pre-padded messages given as big-endian u32 words.
 
-    blocks_u8: u8[L, B*64] — SHA-padded messages (B 64-byte blocks each).
-    nblocks:   i32[L]      — how many blocks of each lane are real.
+    words:   u32[L, B*16] — SHA-padded messages (B 64-byte blocks each).
+    nblocks: i32[L]       — how many blocks of each lane are real.
     L must be a multiple of 128 (lane-tile width). Returns u8[L, 32] digests.
     """
-    L, nbytes = blocks_u8.shape
-    B = nbytes // 64
+    L, nwords = words.shape
+    B = nwords // 16
     R = L // 128
-    # Bytes -> big-endian u32 words: (B, L, 16) so the scan slices are cheap.
-    w8 = blocks_u8.reshape(L, B, 16, 4).astype(jnp.uint32)
-    words = ((w8[..., 0] << 24) | (w8[..., 1] << 16) | (w8[..., 2] << 8) | w8[..., 3])
+    # Pre-transpose to (B, 16, R, 128) so each scan step slices contiguous
+    # (R, 128) tiles — per-word strided extraction inside the scan body sends
+    # XLA:CPU's layout/LLVM pipeline into a multi-minute compile.
+    wt = jnp.transpose(words.reshape(L, B, 16), (1, 2, 0)).reshape(B, 16, R, 128)
     nb2 = nblocks.reshape(R, 128)
 
     def step(state, xs):
-        j, blk = xs  # blk: u32[L, 16]
-        w = [blk[:, i].reshape(R, 128) for i in range(16)]
-        new = _compress(state, w)
+        j, blk = xs  # blk: u32[16, R, 128]
+        new = _compress(state, blk)
         active = j < nb2
         return [jnp.where(active, n, s) for n, s in zip(new, state)], None
 
-    init = [jnp.broadcast_to(jnp.uint32(_H0[i]), (R, 128)) for i in range(8)]
-    xs = (jnp.arange(B, dtype=jnp.int32), jnp.moveaxis(words, 1, 0))
+    # +0*message words: ties the carry init's varying-manual-axes to the data
+    # input so the scan body typechecks under shard_map (device-varying) and
+    # plain jit alike.
+    zero = wt[0, 0] * 0 + (nb2 * 0).astype(jnp.uint32)
+    init = [jnp.uint32(_H0[i]) + zero for i in range(8)]
+    xs = (jnp.arange(B, dtype=jnp.int32), wt)
     state, _ = jax.lax.scan(step, init, xs)
     # 8 x u32[R,128] -> big-endian u8[L, 32]
     st = jnp.stack([s.reshape(L) for s in state], axis=1)  # u32[L, 8]
     out = jnp.stack([(st >> np.uint32(s)).astype(jnp.uint8)
                      for s in (24, 16, 8, 0)], axis=-1)
     return out.reshape(L, 32)
+
+
+@jax.jit
+def sha256_lanes(blocks_u8: jax.Array, nblocks: jax.Array) -> jax.Array:
+    """SHA-256 of L pre-padded byte messages in parallel.
+
+    blocks_u8: u8[L, B*64] — SHA-padded messages (B 64-byte blocks each).
+    nblocks:   i32[L]      — how many blocks of each lane are real.
+    L must be a multiple of 128 (lane-tile width). Returns u8[L, 32] digests.
+    """
+    L, nbytes = blocks_u8.shape
+    w8 = blocks_u8.reshape(L, nbytes // 4, 4).astype(jnp.uint32)
+    words = ((w8[..., 0] << 24) | (w8[..., 1] << 16)
+             | (w8[..., 2] << 8) | w8[..., 3])
+    return sha256_words(words, nblocks)
 
 
 def _pad_bucket(data: np.ndarray, offs: np.ndarray, lens: np.ndarray,

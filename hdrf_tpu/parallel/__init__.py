@@ -1,0 +1,11 @@
+"""Multi-chip parallelism: mesh construction, sequence-parallel CDC scan with
+ICI halo exchange, data-parallel SHA lanes, and the combined sharded
+reduction step (see sharded.py)."""
+
+from hdrf_tpu.parallel.sharded import (  # noqa: F401
+    candidate_words_sharded,
+    gear_candidates_sharded,
+    make_mesh,
+    reduction_step,
+    sha256_lanes_sharded,
+)

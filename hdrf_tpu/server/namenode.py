@@ -102,6 +102,14 @@ class LeaseManager:
     def drop(self, path: str) -> None:
         self._leases.pop(path, None)
 
+    def drop_subtree(self, prefix: str) -> None:
+        """Release leases on ``prefix`` and everything under it (directory
+        delete must not leave stale leases blocking re-creation)."""
+        p = prefix.rstrip("/")
+        for path in list(self._leases):
+            if path == p or path.startswith(p + "/"):
+                del self._leases[path]
+
 
 class NameNode:
     def __init__(self, config: NameNodeConfig | None = None):
@@ -112,6 +120,7 @@ class NameNode:
         self._blocks: dict[int, BlockInfo] = {}
         self._datanodes: dict[str, DatanodeInfo] = {}
         self._leases = LeaseManager()
+        self._pending_repl: dict[int, float] = {}  # block_id -> retry deadline
         self._next_block_id = 1
         self._gen_stamp = 1
         self._editlog = EditLog(self.config.meta_dir,
@@ -147,8 +156,17 @@ class NameNode:
         snap = self._editlog.load_image()
         if snap is not None:
             self._restore(snap)
-        self._editlog.replay(self._apply)
+        self._editlog.replay(self._apply_tolerant)
         self._editlog.open_for_append(self._snapshot)
+
+    def _apply_tolerant(self, rec: list) -> None:
+        """Replay-path apply: a record that no longer applies (e.g. the WAL
+        tail diverged because an append failed mid-crash) is skipped with a
+        count rather than crash-looping the NameNode on startup."""
+        try:
+            self._apply(rec)
+        except Exception:  # noqa: BLE001 — startup must make progress
+            _M.incr("replay_records_skipped")
 
     def _snapshot(self) -> dict:
         def walk(node: dict) -> dict:
@@ -221,8 +239,15 @@ class NameNode:
             self._rename_apply(rec[1], rec[2])
 
     def _log(self, rec: list) -> None:
-        self._editlog.append(rec)
+        """Apply-then-append: the mutation is validated against live state
+        *before* it reaches the WAL, so a rejected op (mkdir over a file,
+        rename onto an existing dst, ...) raises to the client without
+        leaving a record that would poison every future replay.  Appending
+        after a successful apply is safe for single-writer edits: the lock is
+        held, and a crash between apply and append merely loses the op (the
+        client never got an ack — same contract as FSEditLog.logSync)."""
         self._apply(rec)
+        self._editlog.append(rec)
 
     # ------------------------------------------------------- tree utilities
 
@@ -286,7 +311,8 @@ class NameNode:
                         if dn:
                             dn.commands.append({"cmd": "invalidate",
                                                 "block_ids": [bid]})
-            self._leases.drop(path)
+        # in-flight writes anywhere under the deleted path lose their leases
+        self._leases.drop_subtree(path)
 
     def _rename_apply(self, src: str, dst: str) -> None:
         sparent, sname = self._parent_of(src)
@@ -551,22 +577,32 @@ class NameNode:
 
     def _check_replication(self) -> None:
         with self._lock:
+            now = time.monotonic()
             for info in self._blocks.values():
                 node = self._try_file(info.path)
                 if node is None or not node.complete:
                     continue
                 live = {d for d in info.locations if d in self._datanodes}
                 deficit = node.replication - len(live)
-                if deficit > 0 and live:
-                    targets = self._choose_targets(deficit, exclude=live)
-                    if targets:
-                        src = self._datanodes[next(iter(live))]
-                        src.commands.append({
-                            "cmd": "replicate", "block_id": info.block_id,
-                            "gen_stamp": info.gen_stamp,
-                            "targets": [{"dn_id": t.dn_id, "addr": list(t.addr)}
-                                        for t in targets]})
-                        _M.incr("replications_scheduled")
+                if deficit <= 0 or not live:
+                    self._pending_repl.pop(info.block_id, None)
+                    continue
+                # PendingReconstructionBlocks analog: don't re-queue the same
+                # block every monitor tick while a transfer is in flight.
+                deadline = self._pending_repl.get(info.block_id, 0.0)
+                if deadline > now:
+                    continue
+                targets = self._choose_targets(deficit, exclude=live)
+                if targets:
+                    src = self._datanodes[next(iter(live))]
+                    src.commands.append({
+                        "cmd": "replicate", "block_id": info.block_id,
+                        "gen_stamp": info.gen_stamp,
+                        "targets": [{"dn_id": t.dn_id, "addr": list(t.addr)}
+                                    for t in targets]})
+                    self._pending_repl[info.block_id] = (
+                        now + self.config.pending_replication_timeout_s)
+                    _M.incr("replications_scheduled")
 
     def _recover_leases(self) -> None:
         with self._lock:

@@ -1,17 +1,53 @@
-"""Test env: force JAX onto an 8-device virtual CPU mesh before jax imports.
+"""Test env: force JAX onto an 8-device virtual CPU mesh.
 
-Sharding tests (tests/test_sharding.py) exercise real Mesh/shard_map code paths on
-these virtual devices, mirroring how the driver's dryrun validates multi-chip
-compilation without real chips.
+Sharding tests (tests/test_sharding.py) exercise real Mesh/shard_map code
+paths on these virtual devices, mirroring how the driver's dryrun validates
+multi-chip compilation without real chips.
+
+The dev tunnel's sitecustomize imports jax at interpreter start with
+JAX_PLATFORMS=axon, which bakes the axon platform into jax's config defaults;
+the late ``jax.config.update("jax_platforms", "cpu")`` escape hatch leaves
+compilation routed through the tunnel's remote-compile helper, where XLA-CPU
+programs hang.  Platform selection must happen via process env at interpreter
+start, so when the env is wrong we relaunch pytest once in a child process
+with the corrected environment (suspending pytest's fd capture so the child's
+report reaches the terminal).  HDRF_TEST_TPU=1 opts out, running the suite
+against the real attached chip instead.
 """
 
 import os
+import subprocess
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_WRONG_ENV = (os.environ.get("HDRF_TEST_TPU") != "1"
+              and os.environ.get("JAX_PLATFORMS") != "cpu")
+
+
+def pytest_configure(config):
+    if not _WRONG_ENV or config.option.collectonly:
+        return
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Without this the tunnel's sitecustomize registers the axon backend,
+    # which force-selects jax_platforms="axon,cpu" no matter what the env
+    # says; the CPU suite must not touch the tunnel at all.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.suspend_global_capture(in_=True)
+    rc = subprocess.call([sys.executable, "-m", "pytest", *sys.argv[1:]],
+                         env=env)
+    os._exit(rc)
+
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -188,3 +188,44 @@ class TestBlockManagement:
         nn.rpc_create("/f", client="c1")
         with pytest.raises(IOError):
             nn.rpc_add_block("/f", client="c1")
+
+
+class TestWalIntegrity:
+    def test_rejected_op_does_not_poison_wal(self, nn, tmp_path):
+        """mkdir over an existing file must fail *without* leaving a WAL
+        record that would crash every future NameNode start (apply-before-
+        append in NameNode._log)."""
+        register(nn)
+        nn.rpc_create("/a", client="c1")
+        nn.rpc_complete("/a", client="c1", block_lengths={})
+        with pytest.raises(FileExistsError):
+            nn.rpc_mkdir("/a/b")
+        with pytest.raises(FileExistsError):
+            nn.rpc_create("/x", client="c1") and nn.rpc_complete(
+                "/x", client="c1", block_lengths={}) and nn.rpc_rename("/x", "/a")
+        nn._editlog.close()
+        # restart over the same meta dir must succeed and keep the namespace
+        nn2 = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "name")))
+        assert nn2.rpc_stat("/a")["type"] == "file"
+        nn2._editlog.close()
+
+    def test_delete_dir_releases_child_leases(self, nn):
+        register(nn)
+        nn.rpc_create("/d/f", client="c1")   # lease held, file incomplete
+        nn.rpc_delete("/d")
+        # the path must be immediately re-creatable by another client
+        nn.rpc_create("/d/f", client="c2")
+
+    def test_replication_not_requeued_every_tick(self, nn):
+        register(nn, n=3)
+        nn.rpc_create("/f", client="c1")
+        alloc = nn.rpc_add_block("/f", client="c1")
+        bid = alloc["block_id"]
+        nn.rpc_complete("/f", client="c1", block_lengths={bid: 10})
+        # one replica reported on dn-0 only; replication=2 -> deficit 1
+        nn.rpc_block_received("dn-0", bid, 10)
+        nn._check_replication()
+        nn._check_replication()  # second tick while transfer "in flight"
+        cmds = [c for d in nn._datanodes.values() for c in d.commands
+                if c["cmd"] == "replicate"]
+        assert len(cmds) == 1
