@@ -80,11 +80,19 @@ class HdrfClient:
     # ----------------------------------------------------------------- write
 
     def write(self, path: str, data: bytes, scheme: str | None = None,
-              replication: int | None = None) -> None:
-        """Write a whole file (the put path, §3.1 of SURVEY.md)."""
+              replication: int | None = None, ec: str | None = None) -> None:
+        """Write a whole file (the put path, §3.1 of SURVEY.md).  ``ec`` is an
+        erasure-coding policy name ('rs-6-3-64k'): the file is cell-striped
+        over k+m DataNodes instead of replicated (client/striped.py)."""
         with _TR.span("write") as sp:
             sp.annotate("path", path)
             sp.annotate("bytes", len(data))
+            if ec is not None:
+                from hdrf_tpu.client.striped import StripedWriter
+
+                StripedWriter(self).write(path, data, ec)
+                _M.incr("files_written")
+                return
             info = self._nn.call("create", path=path, client=self.name,
                                  replication=replication, scheme=scheme)
             block_size = info["block_size"]
@@ -146,6 +154,13 @@ class HdrfClient:
             end = total if length < 0 else min(offset + length, total)
             if offset >= end:
                 return b""
+            if loc.get("ec"):
+                from hdrf_tpu.client.striped import StripedReader
+
+                data = StripedReader(self).read(loc, offset, end)
+                _M.incr("files_read")
+                _M.incr("bytes_read", len(data))
+                return data
             out = bytearray()
             pos = 0
             for binfo in loc["blocks"]:
@@ -165,6 +180,18 @@ class HdrfClient:
         locations = binfo["locations"]
         if not locations:
             raise IOError(f"block {binfo['block_id']} has no live locations")
+        # Short-circuit: a co-located DN passes the replica fd over its unix
+        # socket and we pread directly (ShortCircuitCache.java:72 analog).
+        if self.config.short_circuit:
+            from hdrf_tpu.server.shortcircuit import read_local
+
+            for loc in locations:
+                sc = loc.get("sc_path")
+                if sc and loc["addr"][0] in ("127.0.0.1", "localhost"):
+                    data = read_local(sc, binfo["block_id"], offset, length)
+                    if data is not None:
+                        _M.incr("short_circuit_reads")
+                        return data
         last_err: Exception | None = None
         for loc in locations:  # failover across replicas
             try:

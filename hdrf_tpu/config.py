@@ -97,6 +97,9 @@ class DataNodeConfig:
     packet_size: int = 64 * 1024
     heartbeat_interval_s: float = 1.0
     block_report_interval_s: float = 30.0
+    # Rolling replica verification cadence (BlockScanner analog); one block
+    # verified per tick, 0 disables.
+    scan_interval_s: float = 30.0
     reduction: ReductionConfig = field(default_factory=ReductionConfig)
 
 
@@ -106,6 +109,9 @@ class ClientConfig:
     # Outstanding un-acked packets in the write pipeline (DataStreamer window).
     max_inflight_packets: int = 16
     read_retries: int = 3
+    # Short-circuit local reads: fd passing over the DN's unix socket
+    # (dfs.client.read.shortcircuit analog).
+    short_circuit: bool = True
 
 
 @dataclass

@@ -72,3 +72,27 @@ def fingerprints(data: bytes | np.ndarray, cuts: np.ndarray,
     starts = np.concatenate([[0], cuts[:-1]]).astype(np.uint64)
     lens = (cuts - starts).astype(np.uint64)
     return native.sha256_batch(data, starts, lens)
+
+
+_resident_cache: dict = {}
+
+
+def chunk_and_fingerprint(data: bytes | np.ndarray, cdc: CdcConfig,
+                          backend: str = "native"):
+    """(cuts, digests) in one call — THE entry point for the write path.
+
+    On the TPU backend this routes through ops.resident.ResidentReducer so
+    the block crosses to HBM once and the gather/SHA read the resident image
+    (the naive chunk_cuts+fingerprints composition re-uploads the block per
+    stage).  The native path is the CPU baseline pair of calls.
+    """
+    if backend == "tpu":
+        from hdrf_tpu.ops.resident import ResidentReducer
+
+        key = (cdc.mask_bits, cdc.min_chunk, cdc.max_chunk)
+        r = _resident_cache.get(key)
+        if r is None:
+            r = _resident_cache[key] = ResidentReducer(cdc)
+        return r.reduce(data)
+    cuts = chunk_cuts(data, cdc, backend)
+    return cuts, fingerprints(data, cuts, backend)

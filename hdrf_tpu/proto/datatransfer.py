@@ -111,6 +111,30 @@ def stream_bytes(sock: socket.socket, data: bytes,
     return seqno - base_seqno + 1
 
 
+def fetch_block(addr: tuple, block_id: int, offset: int = 0,
+                length: int = -1, timeout: float = 60) -> bytes:
+    """One-shot READ_BLOCK: connect, request [offset, offset+length), collect
+    the packet run, length-check.  Shared by the EC degraded-read path
+    (client/striped.py) and DN reconstruction fan-in (server/datanode.py)."""
+    from hdrf_tpu.proto.rpc import recv_frame
+
+    sock = socket.create_connection(addr, timeout=timeout)
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_op(sock, READ_BLOCK, block_id=block_id, offset=offset,
+                length=length)
+        hdr = recv_frame(sock)
+        if hdr["status"] != 0:
+            raise IOError(f"datanode error: {hdr['error']}: "
+                          f"{hdr.get('message', '')}")
+        data = collect_packets(sock)
+        if len(data) != hdr["length"]:
+            raise IOError(f"short read: {len(data)} != {hdr['length']}")
+        return data
+    finally:
+        sock.close()
+
+
 def collect_packets(sock: socket.socket, ack_sock: socket.socket | None = None,
                     on_packet=None) -> bytes:
     """Receive a full packet run; optionally ack each packet on ``ack_sock``

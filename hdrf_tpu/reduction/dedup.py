@@ -53,8 +53,8 @@ class DedupScheme(ReductionScheme):
         tr = tracing.current_context()
         with tracing.tracer("dedup").span("reduce", parent=tr) as sp:
             buf = np.frombuffer(data, dtype=np.uint8)
-            cuts = dispatch.chunk_cuts(buf, ctx.config.cdc, ctx.backend)
-            digests = dispatch.fingerprints(buf, cuts, ctx.backend)
+            cuts, digests = dispatch.chunk_and_fingerprint(
+                buf, ctx.config.cdc, ctx.backend)
             starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
             n = len(cuts)
 

@@ -89,6 +89,9 @@ class DataNode:
 
         self._server = Server((config.host, config.port), Handler)
         self._conns: set[socket.socket] = set()
+        from hdrf_tpu.server.shortcircuit import ShortCircuitServer
+        self._sc = ShortCircuitServer(
+            self, os.path.join(config.data_dir, "sc.sock"))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -101,15 +104,22 @@ class DataNode:
                              name=f"{self.dn_id}-xceiver", daemon=True)
         t.start()
         self._threads.append(t)
+        self._sc.start()
         self._register()
         hb = threading.Thread(target=self._heartbeat_loop,
                               name=f"{self.dn_id}-heartbeat", daemon=True)
         hb.start()
         self._threads.append(hb)
+        if self.config.scan_interval_s > 0:
+            sc = threading.Thread(target=self._scanner_loop,
+                                  name=f"{self.dn_id}-scanner", daemon=True)
+            sc.start()
+            self._threads.append(sc)
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self._sc.stop()
         self._server.shutdown()
         self._server.server_close()
         self._sever_connections()
@@ -214,7 +224,7 @@ class DataNode:
 
     def _register(self) -> None:
         self._nn.call("register_datanode", dn_id=self.dn_id,
-                      addr=list(self.addr))
+                      addr=list(self.addr), sc_path=self._sc.path)
         self._send_block_report()
 
     def _send_block_report(self) -> None:
@@ -261,6 +271,8 @@ class DataNode:
                 self._invalidate(bid)
         elif cmd["cmd"] == "replicate":
             self._replicate(cmd)
+        elif cmd["cmd"] == "ec_reconstruct":
+            self._ec_reconstruct(cmd)
 
     def _invalidate(self, block_id: int) -> None:
         meta = self.replicas.get_meta(block_id)
@@ -284,8 +296,95 @@ class DataNode:
                                     cmd["targets"])
         _M.incr("blocks_replicated")
 
+    def _ec_reconstruct(self, cmd: dict) -> None:
+        """DNA_ERASURE_CODING_RECONSTRUCTION: fan-in k surviving shards from
+        peer DNs, RS-decode the lost shard (MXU bit-matmul, ops/rs.py), store
+        it locally (ErasureCodingWorker/StripedBlockReconstructor analog —
+        fan-in at erasurecode/StripedBlockReader, decode, StripedBlockWriter)."""
+        import numpy as np
+
+        from hdrf_tpu.ops import rs
+
+        k, m, cell = rs.parse_policy(cmd["policy"])
+        shards: dict[int, np.ndarray] = {}
+        for surv in cmd["survivors"]:
+            if len(shards) >= k:
+                break
+            for loc in surv["locations"]:
+                try:
+                    data = dt.fetch_block(tuple(loc["addr"]),
+                                          surv["block_id"])
+                    shards[surv["index"]] = np.frombuffer(data, dtype=np.uint8)
+                    break
+                except (OSError, ConnectionError, IOError):
+                    continue
+        if len(shards) < k:
+            _M.incr("ec_reconstruct_failures")
+            return
+        rec = rs.rs_decode(shards, k, m, want=[cmd["index"]])[cmd["index"]]
+        writer = self.replicas.create_rbw(cmd["block_id"], cmd["gen_stamp"])
+        try:
+            writer.write(rec.tobytes())
+            from hdrf_tpu import native
+            crcs = [int(c) for c in native.crc32c_chunks(rec.tobytes(),
+                                                         self.checksum_chunk)]
+            meta = writer.finalize(rec.size, "direct", crcs,
+                                   self.checksum_chunk)
+        except Exception:
+            writer.abort()
+            raise
+        self.notify_block_received(cmd["block_id"], meta.logical_len)
+        _M.incr("ec_blocks_reconstructed")
+
     # ------------------------------------------------------------ inspection
 
     def run_directory_scan(self) -> list[str]:
         """DirectoryScanner trigger (tests + admin)."""
         return self.replicas.scan()
+
+    # ----------------------------------------------------------- block scanner
+
+    def _scanner_loop(self) -> None:
+        """BlockScanner/VolumeScanner analog: rolling checksum verification of
+        finalized replicas at a throttled rate; corrupt replicas are reported
+        to the NN (markBlockAsCorrupt path) which drops the location and lets
+        the redundancy monitor re-replicate from a good copy."""
+        interval = self.config.scan_interval_s
+        cursor = 0
+        while not self._stop.wait(interval):
+            try:
+                bids = sorted(self.replicas.block_ids())
+                if not bids:
+                    continue
+                bid = bids[cursor % len(bids)]
+                cursor += 1
+                bad = self.verify_block(bid)
+                if bad:
+                    _M.incr("scanner_corrupt_found")
+                    self._nn.call("bad_block", dn_id=self.dn_id, block_id=bid)
+                    self._invalidate(bid)
+            except (OSError, ConnectionError):
+                _M.incr("scanner_errors")
+            except Exception:  # noqa: BLE001
+                _M.incr("scanner_errors")
+
+    def verify_block(self, block_id: int) -> bool:
+        """True if the replica is corrupt (stored checksums don't match).
+        Reduced replicas verify their reconstructed logical bytes — corruption
+        in the chunk store surfaces here too."""
+        from hdrf_tpu import native
+
+        meta = self.replicas.get_meta(block_id)
+        if meta is None or not meta.checksums:
+            return False
+        if meta.scheme == "direct":
+            data = self.replicas.read_data(block_id)
+        else:
+            stored = (self.replicas.read_data(block_id)
+                      if meta.physical_len else b"")
+            data = self.scheme(meta.scheme).reconstruct(
+                block_id, stored, meta.logical_len, self.reduction_ctx)
+        crcs = [int(c) for c in native.crc32c_chunks(data,
+                                                     meta.checksum_chunk)]
+        _M.incr("blocks_scanned")
+        return crcs != list(meta.checksums)

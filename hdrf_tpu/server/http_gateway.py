@@ -1,0 +1,145 @@
+"""HTTP gateway: the WebHDFS REST surface + status pages.
+
+Re-expression of the reference's HTTP layer — `hdfs/web/WebHdfsFileSystem`
+(client) + the NN/DN webapps (`webapps/{hdfs,datanode}`) and JMX endpoints —
+as one stateless gateway process over the control/data protocols:
+
+  GET    /webhdfs/v1/<path>?op=LISTSTATUS
+  GET    /webhdfs/v1/<path>?op=GETFILESTATUS
+  GET    /webhdfs/v1/<path>?op=OPEN[&offset=N&length=N]
+  PUT    /webhdfs/v1/<path>?op=MKDIRS
+  PUT    /webhdfs/v1/<path>?op=CREATE[&scheme=S][&ec=P]     (body = bytes)
+  PUT    /webhdfs/v1/<path>?op=RENAME&destination=<dst>
+  DELETE /webhdfs/v1/<path>?op=DELETE
+  GET    /status      cluster overview (datanode report, live counts)
+  GET    /metrics     all metric registries (JMX/metrics2 analog)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+from hdrf_tpu.client.filesystem import HdrfClient
+from hdrf_tpu.utils import metrics
+
+_M = metrics.registry("http_gateway")
+PREFIX = "/webhdfs/v1"
+
+
+class HttpGateway:
+    def __init__(self, namenode_addr: tuple[str, int], host: str = "127.0.0.1",
+                 port: int = 0):
+        self._nn_addr = namenode_addr
+        gateway = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _bytes(self, data: bytes) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _dispatch(self, method: str) -> None:
+                _M.incr("requests")
+                u = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                try:
+                    if u.path == "/status":
+                        return self._json(200, gateway.status())
+                    if u.path == "/metrics":
+                        return self._json(200, gateway.metrics())
+                    if not u.path.startswith(PREFIX):
+                        return self._json(404, {"error": "not found"})
+                    path = unquote(u.path[len(PREFIX):]) or "/"
+                    op = q.get("op", "").upper()
+                    with HdrfClient(gateway._nn_addr, name="http-gw") as c:
+                        return self._op(c, method, op, path, q)
+                except Exception as e:  # noqa: BLE001 — HTTP boundary
+                    # RPC errors carry the server-side exception name
+                    # (RemoteException analog); map it onto HTTP semantics.
+                    name = getattr(e, "error", type(e).__name__)
+                    code = {"FileNotFoundError": 404, "IsADirectoryError": 400,
+                            "NotADirectoryError": 400, "FileExistsError": 409,
+                            "PermissionError": 403}.get(name, 500)
+                    self._json(code, {"error": name, "message": str(e)})
+
+            def _op(self, c: HdrfClient, method: str, op: str, path: str,
+                    q: dict) -> None:
+                if method == "GET" and op == "LISTSTATUS":
+                    self._json(200, {"FileStatuses": {
+                        "FileStatus": c.ls(path)}})
+                elif method == "GET" and op == "GETFILESTATUS":
+                    self._json(200, {"FileStatus": c.stat(path)})
+                elif method == "GET" and op == "OPEN":
+                    data = c.read(path, offset=int(q.get("offset", 0)),
+                                  length=int(q.get("length", -1)))
+                    self._bytes(data)
+                elif method == "PUT" and op == "MKDIRS":
+                    self._json(200, {"boolean": c.mkdir(path)})
+                elif method == "PUT" and op == "CREATE":
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n)
+                    c.write(path, body, scheme=q.get("scheme"),
+                            ec=q.get("ec"))
+                    self._json(201, {"length": len(body)})
+                elif method == "PUT" and op == "RENAME":
+                    self._json(200, {"boolean": c.rename(
+                        path, q["destination"])})
+                elif method == "DELETE" and op == "DELETE":
+                    self._json(200, {"boolean": c.delete(path)})
+                else:
+                    self._json(400, {"error": "UnsupportedOperationException",
+                                     "message": f"{method} {op}"})
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="http-gateway", daemon=True)
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._server.server_address
+
+    def start(self) -> "HttpGateway":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def status(self) -> dict:
+        with HdrfClient(self._nn_addr, name="http-gw") as c:
+            report = c.datanode_report()
+        return {"datanodes": report,
+                "live": sum(1 for d in report if d["alive"]),
+                "dead": sum(1 for d in report if not d["alive"])}
+
+    def metrics(self) -> dict:
+        with HdrfClient(self._nn_addr, name="http-gw") as c:
+            return c._nn.call("metrics")

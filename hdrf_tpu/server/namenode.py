@@ -41,9 +41,11 @@ _M = metrics.registry("namenode")
 class FileNode:
     replication: int
     scheme: str
-    blocks: list[int] = field(default_factory=list)
+    blocks: list[int] = field(default_factory=list)  # block ids, or group
+    # leader ids for EC files (groups resolved via NameNode._groups)
     complete: bool = False
     mtime: float = 0.0
+    ec: str | None = None  # EC policy name ("rs-6-3-64k") or None
 
 
 @dataclass
@@ -56,6 +58,15 @@ class BlockInfo:
 
 
 @dataclass
+class GroupInfo:
+    """EC block group: k+m internal blocks striped over distinct DNs
+    (the BlockInfoStriped / block-group analog)."""
+    group_id: int              # == bids[0]
+    bids: list[int]
+    logical_len: int = -1      # group's logical bytes; -1 until complete()
+
+
+@dataclass
 class DatanodeInfo:
     dn_id: str
     addr: tuple[str, int]  # data-transfer endpoint
@@ -63,6 +74,7 @@ class DatanodeInfo:
     blocks: set[int] = field(default_factory=set)
     commands: list[dict] = field(default_factory=list)  # queued for next heartbeat
     stats: dict = field(default_factory=dict)
+    sc_path: str | None = None  # short-circuit unix socket (co-located reads)
 
 
 class LeaseManager:
@@ -118,9 +130,11 @@ class NameNode:
         # namespace: nested dict tree; leaves are FileNode
         self._root: dict[str, Any] = {}
         self._blocks: dict[int, BlockInfo] = {}
+        self._groups: dict[int, GroupInfo] = {}  # EC group_id -> group
         self._datanodes: dict[str, DatanodeInfo] = {}
         self._leases = LeaseManager()
         self._pending_repl: dict[int, float] = {}  # block_id -> retry deadline
+        self._pending_moves: dict[int, str] = {}   # balancer: block -> old DN
         self._next_block_id = 1
         self._gen_stamp = 1
         self._editlog = EditLog(self.config.meta_dir,
@@ -174,7 +188,8 @@ class NameNode:
             for name, child in node.items():
                 if isinstance(child, FileNode):
                     out[name] = ["f", child.replication, child.scheme,
-                                 child.blocks, child.complete, child.mtime]
+                                 child.blocks, child.complete, child.mtime,
+                                 child.ec]
                 else:
                     out[name] = ["d", walk(child)]
             return out
@@ -183,6 +198,8 @@ class NameNode:
             "tree": walk(self._root),
             "blocks": {b.block_id: [b.gen_stamp, b.length, b.path]
                        for b in self._blocks.values()},
+            "groups": {g.group_id: [g.bids, g.logical_len]
+                       for g in self._groups.values()},
             "next_block_id": self._next_block_id,
             "gen_stamp": self._gen_stamp,
         }
@@ -192,7 +209,8 @@ class NameNode:
             out: dict[str, Any] = {}
             for name, v in m.items():
                 if v[0] == "f":
-                    out[name] = FileNode(v[1], v[2], list(v[3]), v[4], v[5])
+                    out[name] = FileNode(v[1], v[2], list(v[3]), v[4], v[5],
+                                         v[6] if len(v) > 6 else None)
                 else:
                     out[name] = walk(v[1])
             return out
@@ -200,6 +218,8 @@ class NameNode:
         self._root = walk(snap["tree"])
         self._blocks = {bid: BlockInfo(bid, gs, ln, path)
                         for bid, (gs, ln, path) in snap["blocks"].items()}
+        self._groups = {gid: GroupInfo(gid, list(bids), ln)
+                        for gid, (bids, ln) in snap.get("groups", {}).items()}
         self._next_block_id = snap["next_block_id"]
         self._gen_stamp = snap["gen_stamp"]
 
@@ -209,9 +229,19 @@ class NameNode:
         if op == "mkdir":
             self._mkdir_apply(rec[1])
         elif op == "create":
-            _, path, replication, scheme, mtime = rec
+            _, path, replication, scheme, mtime, *rest = rec
             parent, name = self._parent_of(path, create=True)
-            parent[name] = FileNode(replication, scheme, mtime=mtime)
+            parent[name] = FileNode(replication, scheme, mtime=mtime,
+                                    ec=rest[0] if rest else None)
+        elif op == "add_block_group":
+            _, path, bids, gs = rec
+            node = self._file(path)
+            node.blocks.append(bids[0])
+            self._groups[bids[0]] = GroupInfo(bids[0], list(bids))
+            for bid in bids:
+                self._blocks[bid] = BlockInfo(bid, gs, -1, path)
+            self._next_block_id = max(self._next_block_id, max(bids) + 1)
+            self._gen_stamp = max(self._gen_stamp, gs + 1)
         elif op == "add_block":
             _, path, bid, gs = rec
             node = self._file(path)
@@ -231,7 +261,9 @@ class NameNode:
             node.complete = True
             node.mtime = mtime
             for bid, ln in lengths.items():
-                if bid in self._blocks:
+                if bid in self._groups:
+                    self._groups[bid].logical_len = ln
+                elif bid in self._blocks:
                     self._blocks[bid].length = ln
         elif op == "delete":
             self._delete_apply(rec[1])
@@ -239,15 +271,56 @@ class NameNode:
             self._rename_apply(rec[1], rec[2])
 
     def _log(self, rec: list) -> None:
-        """Apply-then-append: the mutation is validated against live state
-        *before* it reaches the WAL, so a rejected op (mkdir over a file,
-        rename onto an existing dst, ...) raises to the client without
-        leaving a record that would poison every future replay.  Appending
-        after a successful apply is safe for single-writer edits: the lock is
-        held, and a crash between apply and append merely loses the op (the
-        client never got an ack — same contract as FSEditLog.logSync)."""
-        self._apply(rec)
+        """Validate, then append, then apply.  Validation (non-mutating)
+        rejects bad ops — mkdir over a file, rename onto an existing dst —
+        before anything reaches the WAL, so a rejected op cannot poison
+        replay; appending before applying keeps the log-before-apply
+        durability discipline (editlog.py): if the append raises, memory is
+        untouched and the client sees the error; if apply then raises, WAL
+        and memory agree again after a restart replays the record."""
+        self._validate(rec)
         self._editlog.append(rec)
+        self._apply(rec)
+
+    def _peek_parent(self, path: str) -> tuple[dict | None, str]:
+        """Non-mutating walk to ``path``'s parent: raises if a component is a
+        file; returns (None, name) when intermediate dirs don't exist yet
+        (the apply will create them)."""
+        parts = self._parts(path)
+        node: Any = self._root
+        for p in parts[:-1]:
+            child = node.get(p)
+            if child is None:
+                return None, parts[-1]
+            if isinstance(child, FileNode):
+                raise NotADirectoryError(f"{p} in {path} is a file")
+            node = child
+        return node, parts[-1]
+
+    def _validate(self, rec: list) -> None:
+        """Raise iff applying ``rec`` to the current state would raise,
+        without mutating anything."""
+        op = rec[0]
+        if op == "mkdir":
+            try:
+                parent, name = self._peek_parent(rec[1])
+            except NotADirectoryError as e:  # match _mkdir_apply's type
+                raise FileExistsError(str(e)) from None
+            if parent is not None and isinstance(parent.get(name), FileNode):
+                raise FileExistsError(f"{rec[1]}: {name} is a file")
+        elif op == "create":
+            self._peek_parent(rec[1])
+        elif op in ("add_block", "add_block_group", "abandon_block",
+                    "complete"):
+            self._file(rec[1])
+        elif op == "delete":
+            self._parent_of(rec[1])
+            self._resolve(rec[1])
+        elif op == "rename":
+            self._resolve(rec[1])
+            dparent, dname = self._peek_parent(rec[2])
+            if dparent is not None and dname in dparent:
+                raise FileExistsError(rec[2])
 
     # ------------------------------------------------------- tree utilities
 
@@ -303,7 +376,11 @@ class NameNode:
         parent, name = self._parent_of(path)
         node = parent.pop(name, None)
         for fn in self._iter_files(node):
+            bids: list[int] = []
             for bid in fn.blocks:
+                grp = self._groups.pop(bid, None)
+                bids.extend(grp.bids if grp else [bid])
+            for bid in bids:
                 info = self._blocks.pop(bid, None)
                 if info:
                     for dn_id in info.locations:
@@ -345,10 +422,13 @@ class NameNode:
             return True
 
     def rpc_create(self, path: str, client: str, replication: int | None = None,
-                   scheme: str | None = None) -> dict:
+                   scheme: str | None = None, ec: str | None = None) -> dict:
         with self._lock:
             replication = replication or self.config.replication
             scheme = scheme or "direct"
+            if ec is not None:
+                from hdrf_tpu.ops import rs
+                rs.parse_policy(ec)  # validate before logging
             parent, name = self._parent_of(path, create=True)
             existing = parent.get(name)
             if existing is not None:
@@ -362,10 +442,10 @@ class NameNode:
                 # its allocated blocks are invalidated on DNs rather than
                 # leaking in the block map forever.
                 self._log(["delete", path])
-            self._log(["create", path, replication, scheme, time.time()])
+            self._log(["create", path, replication, scheme, time.time(), ec])
             _M.incr("create")
             return {"block_size": self.config.block_size, "scheme": scheme,
-                    "replication": replication}
+                    "replication": replication, "ec": ec}
 
     def rpc_add_block(self, path: str, client: str) -> dict:
         """Allocate the next block + choose target DNs (addBlock RPC ->
@@ -382,6 +462,35 @@ class NameNode:
             return {"block_id": bid, "gen_stamp": gs, "scheme": node.scheme,
                     "targets": [{"dn_id": d.dn_id, "addr": list(d.addr)}
                                 for d in targets]}
+
+    def rpc_add_block_group(self, path: str, client: str) -> dict:
+        """Allocate one EC block group: k+m internal blocks on k+m distinct
+        DNs (DFSStripedOutputStream's block-group allocation analog)."""
+        from hdrf_tpu.ops import rs
+
+        with self._lock:
+            self._leases.check(path, client)
+            node = self._file(path)
+            if not node.ec:
+                raise ValueError(f"{path} is not an EC file")
+            k, m, cell = rs.parse_policy(node.ec)
+            targets = self._choose_targets(k + m, exclude=set())
+            if len(targets) < k + m:
+                # fewer DNs than shards: wrap around (degraded placement;
+                # real deployments require >= k+m racks/nodes)
+                if not targets:
+                    raise IOError("no datanodes available")
+                targets = [targets[i % len(targets)] for i in range(k + m)]
+            bids = list(range(self._next_block_id, self._next_block_id + k + m))
+            gs = self._gen_stamp
+            self._log(["add_block_group", path, bids, gs])
+            _M.incr("add_block_group")
+            return {"group_id": bids[0], "gen_stamp": gs, "k": k, "m": m,
+                    "cell": cell,
+                    "blocks": [{"block_id": b,
+                                "target": {"dn_id": t.dn_id,
+                                           "addr": list(t.addr)}}
+                               for b, t in zip(bids, targets)]}
 
     def rpc_abandon_block(self, path: str, client: str, block_id: int) -> bool:
         with self._lock:
@@ -406,17 +515,36 @@ class NameNode:
     def rpc_get_block_locations(self, path: str) -> dict:
         with self._lock:
             node = self._file(path)
+            _M.incr("get_block_locations")
+            if node.ec:
+                groups = []
+                for gid in node.blocks:
+                    grp = self._groups[gid]
+                    groups.append({
+                        "group_id": gid,
+                        "gen_stamp": self._blocks[gid].gen_stamp,
+                        "length": grp.logical_len,
+                        "blocks": [{"block_id": b,
+                                    "locations": self._locs_of(b)}
+                                   for b in grp.bids]})
+                return {"ec": node.ec, "groups": groups, "scheme": node.scheme,
+                        "length": sum(max(g["length"], 0) for g in groups),
+                        "complete": node.complete}
             blocks = []
             for bid in node.blocks:
                 info = self._blocks[bid]
-                locs = [{"dn_id": d, "addr": list(self._datanodes[d].addr)}
-                        for d in info.locations if d in self._datanodes]
                 blocks.append({"block_id": bid, "gen_stamp": info.gen_stamp,
-                               "length": info.length, "locations": locs})
-            _M.incr("get_block_locations")
-            return {"blocks": blocks, "scheme": node.scheme,
+                               "length": info.length,
+                               "locations": self._locs_of(bid)})
+            return {"blocks": blocks, "scheme": node.scheme, "ec": None,
                     "length": sum(max(b["length"], 0) for b in blocks),
                     "complete": node.complete}
+
+    def _locs_of(self, bid: int) -> list[dict]:
+        info = self._blocks[bid]
+        return [{"dn_id": d, "addr": list(self._datanodes[d].addr),
+                 "sc_path": self._datanodes[d].sc_path}
+                for d in info.locations if d in self._datanodes]
 
     def rpc_delete(self, path: str) -> bool:
         with self._lock:
@@ -454,20 +582,26 @@ class NameNode:
 
     def _stat_entry(self, name: str, node: Any) -> dict:
         if isinstance(node, FileNode):
-            length = sum(max(self._blocks[b].length, 0) for b in node.blocks
-                         if b in self._blocks)
+            if node.ec:
+                length = sum(max(self._groups[g].logical_len, 0)
+                             for g in node.blocks if g in self._groups)
+            else:
+                length = sum(max(self._blocks[b].length, 0)
+                             for b in node.blocks if b in self._blocks)
             return {"name": name, "type": "file", "length": length,
                     "replication": node.replication, "scheme": node.scheme,
                     "complete": node.complete, "blocks": len(node.blocks),
-                    "mtime": node.mtime}
+                    "mtime": node.mtime, "ec": node.ec}
         return {"name": name, "type": "dir", "children": len(node)}
 
     # --------------------------------------------------- datanode RPC: control
 
-    def rpc_register_datanode(self, dn_id: str, addr: list) -> dict:
+    def rpc_register_datanode(self, dn_id: str, addr: list,
+                              sc_path: str | None = None) -> dict:
         with self._lock:
             self._datanodes[dn_id] = DatanodeInfo(
-                dn_id, (addr[0], addr[1]), last_heartbeat=time.monotonic())
+                dn_id, (addr[0], addr[1]), last_heartbeat=time.monotonic(),
+                sc_path=sc_path)
             _M.incr("dn_registered")
             return {"heartbeat_interval_s": self.config.heartbeat_interval_s}
 
@@ -535,6 +669,76 @@ class NameNode:
             self._editlog.checkpoint()
             return True
 
+    def rpc_bad_block(self, dn_id: str, block_id: int) -> bool:
+        """A DN's scanner found a corrupt replica: drop the location so the
+        redundancy monitor re-replicates from a good copy
+        (BlockManager.markBlockAsCorrupt analog)."""
+        with self._lock:
+            info = self._blocks.get(block_id)
+            dn = self._datanodes.get(dn_id)
+            if info is None:
+                return False
+            info.locations.discard(dn_id)
+            if dn is not None:
+                dn.blocks.discard(block_id)
+            self._pending_repl.pop(block_id, None)  # reschedule immediately
+            _M.incr("corrupt_replicas_reported")
+            return True
+
+    def rpc_datanode_blocks(self, dn_id: str, limit: int = 100) -> list[int]:
+        """Balancer support: a sample of non-EC block ids hosted by ``dn_id``
+        that have at least one other live replica source."""
+        with self._lock:
+            dn = self._datanodes.get(dn_id)
+            if dn is None:
+                return []
+            ec_bids = {b for g in self._groups.values() for b in g.bids}
+            out = []
+            for bid in dn.blocks:
+                if bid in ec_bids or bid in self._pending_moves:
+                    continue
+                out.append(bid)
+                if len(out) >= limit:
+                    break
+            return out
+
+    def rpc_move_block(self, block_id: int, from_dn: str, to_dn: str) -> bool:
+        """Balancer support: copy a replica to ``to_dn`` (reduced-form push),
+        then invalidate on ``from_dn`` once the new location reports in
+        (the Dispatcher/replaceBlock analog of the reference's Balancer)."""
+        with self._lock:
+            info = self._blocks.get(block_id)
+            src = self._datanodes.get(from_dn)
+            dst = self._datanodes.get(to_dn)
+            if info is None or src is None or dst is None:
+                return False
+            if from_dn not in info.locations or to_dn in info.locations:
+                return False
+            src.commands.append({
+                "cmd": "replicate", "block_id": block_id,
+                "gen_stamp": info.gen_stamp,
+                "targets": [{"dn_id": dst.dn_id, "addr": list(dst.addr)}]})
+            self._pending_moves[block_id] = from_dn
+            return True
+
+    def _settle_moves(self) -> None:
+        """Finish balancer moves: when the new replica has reported, drop the
+        old one (never reduce below the current replica count otherwise)."""
+        with self._lock:
+            for bid, from_dn in list(self._pending_moves.items()):
+                info = self._blocks.get(bid)
+                if info is None or from_dn not in info.locations:
+                    self._pending_moves.pop(bid)
+                    continue
+                others = info.locations - {from_dn}
+                if any(d in self._datanodes for d in others):
+                    dn = self._datanodes.get(from_dn)
+                    if dn is not None:
+                        dn.commands.append({"cmd": "invalidate",
+                                            "block_ids": [bid]})
+                    info.locations.discard(from_dn)
+                    self._pending_moves.pop(bid)
+
     def rpc_metrics(self) -> dict:
         return metrics.all_snapshots()
 
@@ -559,6 +763,7 @@ class NameNode:
                 fault_injection.point("namenode.monitor_tick")
                 self._check_dead_nodes()
                 self._check_replication()
+                self._settle_moves()
                 self._recover_leases()
             except Exception:  # noqa: BLE001 — monitor must survive
                 _M.incr("monitor_errors")
@@ -578,7 +783,11 @@ class NameNode:
     def _check_replication(self) -> None:
         with self._lock:
             now = time.monotonic()
+            self._check_ec_groups(now)
+            ec_bids = {b for g in self._groups.values() for b in g.bids}
             for info in self._blocks.values():
+                if info.block_id in ec_bids:
+                    continue  # EC internal blocks are reconstructed, not copied
                 node = self._try_file(info.path)
                 if node is None or not node.complete:
                     continue
@@ -603,6 +812,49 @@ class NameNode:
                     self._pending_repl[info.block_id] = (
                         now + self.config.pending_replication_timeout_s)
                     _M.incr("replications_scheduled")
+
+    def _check_ec_groups(self, now: float) -> None:
+        """Schedule EC reconstruction for lost internal blocks
+        (BlockManager's DNA_ERASURE_CODING_RECONSTRUCTION path, §3.5)."""
+        from hdrf_tpu.ops import rs
+
+        for grp in self._groups.values():
+            info0 = self._blocks.get(grp.group_id)
+            node = self._try_file(info0.path) if info0 else None
+            if node is None or not node.complete or not node.ec:
+                continue
+            k, m, cell = rs.parse_policy(node.ec)
+            survivors, missing = [], []
+            for i, bid in enumerate(grp.bids):
+                locs = self._locs_of(bid)
+                (survivors if locs else missing).append(
+                    (i, bid, locs))
+            if not missing or len(survivors) < k:
+                continue  # healthy, or unrecoverable (alerting is the
+                # operator's signal: ec_groups_unrecoverable metric)
+            chosen: set[str] = set()
+            for i, bid, _ in missing:
+                if self._pending_repl.get(bid, 0.0) > now:
+                    continue
+                # keep the distinct-placement invariant: exclude survivor
+                # hosts AND DNs already picked for this group's other shards
+                used = {loc["dn_id"] for _, _, ls in survivors
+                        for loc in ls} | chosen
+                targets = self._choose_targets(1, exclude=used)
+                if not targets:
+                    continue
+                chosen.add(targets[0].dn_id)
+                targets[0].commands.append({
+                    "cmd": "ec_reconstruct", "block_id": bid,
+                    "gen_stamp": self._blocks[bid].gen_stamp,
+                    "policy": node.ec, "index": i,
+                    "group_len": grp.logical_len,
+                    "survivors": [{"index": si, "block_id": sb,
+                                   "locations": ls}
+                                  for si, sb, ls in survivors]})
+                self._pending_repl[bid] = (
+                    now + self.config.pending_replication_timeout_s)
+                _M.incr("ec_reconstructions_scheduled")
 
     def _recover_leases(self) -> None:
         with self._lock:

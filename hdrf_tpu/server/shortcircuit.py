@@ -1,0 +1,176 @@
+"""Short-circuit local reads: Unix-domain fd passing.
+
+Re-expression of the reference's short-circuit stack — client
+`hdfs/shortcircuit/ShortCircuitCache.java:72` + DN `ShortCircuitRegistry`
+(REQUEST_SHORT_CIRCUIT_FDS op over a DomainSocket, fd passed with
+SCM_RIGHTS, libhadoop JNI underneath) — in ~100 lines, because Python's
+``socket.send_fds`` wraps the same kernel facility directly.
+
+The DataNode listens on ``<data_dir>/sc.sock``.  A local client asks for a
+block's fds; the DN replies with the replica metadata (scheme, lengths,
+checksums) and, when the replica has a physical data file whose bytes ARE the
+logical bytes (direct scheme), the open file descriptor.  Reduced replicas
+(dedup/compress) answer metadata-only and the client falls back to the TCP
+read path — reconstruction must run on the DN where the chunk store lives.
+"""
+
+from __future__ import annotations
+
+import array
+import json
+import os
+import socket
+import threading
+from typing import TYPE_CHECKING
+
+from hdrf_tpu.utils import metrics
+
+if TYPE_CHECKING:
+    from hdrf_tpu.server.datanode import DataNode
+
+_M = metrics.registry("shortcircuit")
+MAX_REQ = 4096
+
+
+class ShortCircuitServer:
+    """DN side: serve REQUEST_SHORT_CIRCUIT_FDS on a unix socket."""
+
+    def __init__(self, dn: "DataNode", sock_path: str):
+        self._dn = dn
+        self.path = sock_path
+        if os.path.exists(sock_path):
+            os.unlink(sock_path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(sock_path)
+        self._sock.listen(16)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve,
+                                        name="dn-shortcircuit", daemon=True)
+
+    def start(self) -> "ShortCircuitServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            req = json.loads(conn.recv(MAX_REQ).decode())
+            block_id = req["block_id"]
+            meta = self._dn.replicas.get_meta(block_id)
+            if meta is None:
+                conn.sendall(json.dumps({"status": "no_block"}).encode())
+                return
+            resp = {"status": "ok", "scheme": meta.scheme,
+                    "logical_len": meta.logical_len,
+                    "physical_len": meta.physical_len,
+                    "checksum_chunk": meta.checksum_chunk,
+                    "checksums": meta.checksums,
+                    "fd": meta.scheme == "direct" and meta.physical_len > 0}
+            # Length-prefixed reply: checksum lists for large blocks run to
+            # tens of KB, far past any single recv.  The fd rides the
+            # ancillary data of the 4-byte prefix send.
+            payload = json.dumps(resp).encode()
+            prefix = len(payload).to_bytes(4, "little")
+            if resp["fd"]:
+                fd = os.open(self._dn.replicas.data_path(block_id),
+                             os.O_RDONLY)
+                try:
+                    socket.send_fds(conn, [prefix], [fd])
+                finally:
+                    os.close(fd)  # receiver holds its own copy
+                conn.sendall(payload)
+                _M.incr("fds_passed")
+            else:
+                conn.sendall(prefix + payload)
+                _M.incr("metadata_only")
+        except (OSError, ValueError, KeyError):
+            _M.incr("errors")
+        finally:
+            conn.close()
+
+
+def read_local(sock_path: str, block_id: int, offset: int,
+               length: int) -> bytes | None:
+    """Client side: fetch the replica fd over the unix socket and pread the
+    range directly — zero copies through the DN process.  Returns None when
+    short-circuit isn't possible (reduced replica, dead socket, remote DN)."""
+    try:
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(10)
+        conn.connect(sock_path)
+    except OSError:
+        return None
+    fds: list[int] = []
+    try:
+        conn.sendall(json.dumps({"block_id": block_id}).encode())
+        prefix, fds, _, _ = socket.recv_fds(conn, 4, 1)
+        while len(prefix) < 4:
+            more = conn.recv(4 - len(prefix))
+            if not more:
+                return None
+            prefix += more
+        want = int.from_bytes(prefix[:4], "little")
+        buf = bytearray()
+        while len(buf) < want:
+            piece = conn.recv(want - len(buf))
+            if not piece:
+                return None
+            buf += piece
+        resp = json.loads(bytes(buf).decode())
+        if resp.get("status") != "ok" or not resp.get("fd") or not fds:
+            return None
+        end = resp["logical_len"] if length < 0 else min(
+            offset + length, resp["logical_len"])
+        data = os.pread(fds[0], end - offset, offset)
+        if len(data) != end - offset:
+            return None  # truncated replica: fall back, let the scanner act
+        if not _verify(data, offset, resp):
+            _M.incr("checksum_failures")
+            return None  # corrupt local replica: fall back to another copy
+        _M.incr("local_reads")
+        _M.incr("local_bytes", len(data))
+        return data
+    except (OSError, ValueError):
+        return None
+    finally:
+        for fd in fds:
+            os.close(fd)
+        conn.close()
+
+
+def _verify(data: bytes, offset: int, resp: dict) -> bool:
+    """The same end-to-end crc32c verification the TCP read path applies
+    (client/filesystem.py) — a passed fd must not bypass it."""
+    from hdrf_tpu import native
+
+    cchunk = resp.get("checksum_chunk", 0)
+    stored = resp.get("checksums") or []
+    if not cchunk or not stored or offset % cchunk:
+        return True  # unaligned range: verified end-to-end only via TCP path
+    logical = resp["logical_len"]
+    first = offset // cchunk
+    for i in range((len(data) + cchunk - 1) // cchunk):
+        piece = data[i * cchunk:(i + 1) * cchunk]
+        full = len(piece) == cchunk or offset + len(data) == logical
+        if full and first + i < len(stored):
+            if native.crc32c(piece) != stored[first + i]:
+                return False
+    return True

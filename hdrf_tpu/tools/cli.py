@@ -1,0 +1,303 @@
+"""Command-line tools: the reference's shell surface re-expressed.
+
+One entry point (``python -m hdrf_tpu.tools.cli``) with subcommands mirroring
+the reference's launcher + admin tools (``src/main/bin/hdfs`` subcommand
+dispatch; DFSAdmin, OfflineImageViewer / OfflineEditsViewer under
+``hdfs/tools/``; Balancer under ``server/balancer/``):
+
+  namenode / datanode      daemon launchers
+  httpfs                   WebHDFS-style HTTP gateway
+  dfs                      -ls -mkdir -put -get -cat -rm -mv -stat -du
+  dfsadmin                 -report -savenamespace -metrics -movblock
+  oiv / oev                offline fsimage / edit-log viewers
+  balancer                 spread replicas toward the mean DN utilization
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _addr(s: str) -> tuple[str, int]:
+    host, port = s.rsplit(":", 1)
+    return host, int(port)
+
+
+def _client(args):
+    from hdrf_tpu.client.filesystem import HdrfClient
+
+    return HdrfClient(_addr(args.namenode))
+
+
+# ------------------------------------------------------------------- daemons
+
+def cmd_namenode(args) -> int:
+    from hdrf_tpu.config import HdrfConfig
+    from hdrf_tpu.server.namenode import NameNode
+
+    cfg = HdrfConfig.load(args.config)
+    if args.port is not None:
+        cfg.namenode.port = args.port
+    nn = NameNode(cfg.namenode).start()
+    print(f"namenode listening on {nn.addr[0]}:{nn.addr[1]}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        nn.stop()
+    return 0
+
+
+def cmd_datanode(args) -> int:
+    from hdrf_tpu.config import HdrfConfig
+    from hdrf_tpu.server.datanode import DataNode
+
+    cfg = HdrfConfig.load(args.config)
+    if args.data_dir:
+        cfg.datanode.data_dir = args.data_dir
+    dn = DataNode(cfg.datanode, _addr(args.namenode)).start()
+    print(f"datanode {dn.dn_id} listening on {dn.addr[0]}:{dn.addr[1]}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        dn.stop()
+    return 0
+
+
+def cmd_httpfs(args) -> int:
+    from hdrf_tpu.server.http_gateway import HttpGateway
+
+    gw = HttpGateway(_addr(args.namenode), port=args.port).start()
+    print(f"http gateway on http://{gw.addr[0]}:{gw.addr[1]}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        gw.stop()
+    return 0
+
+
+# ----------------------------------------------------------------------- dfs
+
+def cmd_dfs(args) -> int:
+    with _client(args) as c:
+        if args.op == "-ls":
+            for e in c.ls(args.args[0] if args.args else "/"):
+                kind = "d" if e["type"] == "dir" else "-"
+                size = e.get("length", e.get("children", 0))
+                print(f"{kind} {size:>12} {e['name']}")
+        elif args.op == "-mkdir":
+            c.mkdir(args.args[0])
+        elif args.op == "-put":
+            local, remote = args.args
+            with open(local, "rb") as f:
+                c.write(remote, f.read(), scheme=args.scheme, ec=args.ec)
+        elif args.op == "-get":
+            remote, local = args.args
+            data = c.read(remote)
+            with open(local, "wb") as f:
+                f.write(data)
+        elif args.op == "-cat":
+            sys.stdout.buffer.write(c.read(args.args[0]))
+        elif args.op == "-rm":
+            ok = c.delete(args.args[0])
+            if not ok:
+                print(f"no such path: {args.args[0]}", file=sys.stderr)
+                return 1
+        elif args.op == "-mv":
+            c.rename(args.args[0], args.args[1])
+        elif args.op == "-stat":
+            print(json.dumps(c.stat(args.args[0]), indent=2))
+        elif args.op == "-du":
+            total = sum(e.get("length", 0) for e in c.ls(args.args[0])
+                        if e["type"] == "file")
+            print(total)
+        else:
+            print(f"unknown dfs op {args.op}", file=sys.stderr)
+            return 1
+    return 0
+
+
+# ------------------------------------------------------------------ dfsadmin
+
+def cmd_dfsadmin(args) -> int:
+    with _client(args) as c:
+        if args.op == "-report":
+            for d in c.datanode_report():
+                state = "live" if d["alive"] else "dead"
+                stats = d.get("stats", {})
+                print(f"{d['dn_id']:>12} {state:>5} blocks={d['blocks']} "
+                      f"logical={stats.get('logical_bytes', 0)} "
+                      f"physical={stats.get('physical_bytes', 0)}")
+        elif args.op == "-savenamespace":
+            c._nn.call("save_namespace")
+            print("namespace saved")
+        elif args.op == "-metrics":
+            print(json.dumps(c._nn.call("metrics"), indent=2, sort_keys=True))
+        elif args.op == "-movblock":
+            bid, src, dst = args.args
+            ok = c._nn.call("move_block", block_id=int(bid), from_dn=src,
+                            to_dn=dst)
+            print("scheduled" if ok else "rejected")
+            return 0 if ok else 1
+        else:
+            print(f"unknown dfsadmin op {args.op}", file=sys.stderr)
+            return 1
+    return 0
+
+
+# ------------------------------------------------------------------- oiv/oev
+
+def cmd_oiv(args) -> int:
+    """Offline image viewer: dump the fsimage namespace as JSON lines
+    (OfflineImageViewerPB analog)."""
+    from hdrf_tpu.server.editlog import EditLog
+
+    log = EditLog(args.meta_dir)
+    snap = log.load_image()
+    if snap is None:
+        print("no fsimage", file=sys.stderr)
+        return 1
+
+    def walk(tree: dict, prefix: str):
+        for name, v in sorted(tree.items()):
+            path = f"{prefix}/{name}"
+            if v[0] == "f":
+                print(json.dumps({
+                    "path": path, "type": "file", "replication": v[1],
+                    "scheme": v[2], "blocks": v[3], "complete": v[4],
+                    "ec": v[6] if len(v) > 6 else None}))
+            else:
+                print(json.dumps({"path": path, "type": "dir"}))
+                walk(v[1], path)
+
+    print(json.dumps({"image_seq": log.seq,
+                      "next_block_id": snap["next_block_id"],
+                      "gen_stamp": snap["gen_stamp"]}))
+    walk(snap["tree"], "")
+    return 0
+
+
+def cmd_oev(args) -> int:
+    """Offline edits viewer: dump WAL records as JSON lines
+    (OfflineEditsViewer analog)."""
+    import msgpack
+
+    from hdrf_tpu.utils import wal as walmod
+
+    path = os.path.join(args.meta_dir, "edits.wal")
+    for payload in walmod.recover(path, truncate=False):
+        seq, *rec = msgpack.unpackb(payload, raw=False, use_list=True,
+                                    strict_map_key=False)
+        print(json.dumps({"seq": seq, "op": rec[0], "args": rec[1:]}))
+    return 0
+
+
+# ------------------------------------------------------------------ balancer
+
+def cmd_balancer(args) -> int:
+    """Move replicas from over- to under-utilized DNs until every node is
+    within ``threshold`` of the mean (Balancer.java policy, simplified to
+    block counts; the Dispatcher's move legs ride rpc_move_block)."""
+    with _client(args) as c:
+        for _ in range(args.iterations):
+            report = [d for d in c.datanode_report() if d["alive"]]
+            if len(report) < 2:
+                print("not enough live datanodes")
+                return 0
+            mean = sum(d["blocks"] for d in report) / len(report)
+            over = [d for d in report if d["blocks"] > mean + args.threshold]
+            under = sorted((d for d in report
+                            if d["blocks"] < mean - args.threshold),
+                           key=lambda d: d["blocks"])
+            if not over or not under:
+                print(f"balanced: mean={mean:.1f} "
+                      f"spread={[d['blocks'] for d in report]}")
+                return 0
+            moved = 0
+            for src in over:
+                blocks = c._nn.call("datanode_blocks", dn_id=src["dn_id"],
+                                    limit=args.batch)
+                for bid in blocks:
+                    dst = under[moved % len(under)]
+                    if c._nn.call("move_block", block_id=bid,
+                                  from_dn=src["dn_id"], to_dn=dst["dn_id"]):
+                        moved += 1
+                    if moved >= args.batch:
+                        break
+                if moved >= args.batch:
+                    break
+            print(f"scheduled {moved} moves; waiting for settle")
+            time.sleep(args.wait_s)
+    return 0
+
+
+# ---------------------------------------------------------------------- main
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="hdrf")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    d = sub.add_parser("namenode")
+    d.add_argument("--config", default=None)
+    d.add_argument("--port", type=int, default=None)
+    d.set_defaults(fn=cmd_namenode)
+
+    d = sub.add_parser("datanode")
+    d.add_argument("--config", default=None)
+    d.add_argument("--namenode", required=True)
+    d.add_argument("--data-dir", default=None)
+    d.set_defaults(fn=cmd_datanode)
+
+    d = sub.add_parser("httpfs")
+    d.add_argument("--namenode", required=True)
+    d.add_argument("--port", type=int, default=9870)
+    d.set_defaults(fn=cmd_httpfs)
+
+    d = sub.add_parser("dfs")
+    d.add_argument("--namenode", required=True)
+    d.add_argument("--scheme", default=None)
+    d.add_argument("--ec", default=None)
+    d.set_defaults(fn=cmd_dfs, takes_ops=True)
+
+    d = sub.add_parser("dfsadmin")
+    d.add_argument("--namenode", required=True)
+    d.set_defaults(fn=cmd_dfsadmin, takes_ops=True)
+
+    d = sub.add_parser("oiv")
+    d.add_argument("meta_dir")
+    d.set_defaults(fn=cmd_oiv)
+
+    d = sub.add_parser("oev")
+    d.add_argument("meta_dir")
+    d.set_defaults(fn=cmd_oev)
+
+    d = sub.add_parser("balancer")
+    d.add_argument("--namenode", required=True)
+    d.add_argument("--threshold", type=float, default=2.0)
+    d.add_argument("--iterations", type=int, default=10)
+    d.add_argument("--batch", type=int, default=8)
+    d.add_argument("--wait-s", type=float, default=2.0)
+    d.set_defaults(fn=cmd_balancer)
+
+    # dfs/dfsadmin ops are dash-prefixed like the reference shell (-ls,
+    # -put, ...), which argparse won't accept as positionals — collect them
+    # via parse_known_args instead.
+    args, extra = p.parse_known_args(argv)
+    if getattr(args, "takes_ops", False):
+        if not extra:
+            p.error("missing operation")
+        args.op, args.args = extra[0], extra[1:]
+    elif extra:
+        p.error(f"unrecognized arguments: {' '.join(extra)}")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

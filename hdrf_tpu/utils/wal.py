@@ -41,11 +41,12 @@ def scan(data: bytes) -> tuple[list[bytes], int]:
     return payloads, pos
 
 
-def recover(path: str) -> list[bytes]:
+def recover(path: str, truncate: bool = True) -> list[bytes]:
     """Read a WAL, return intact payloads, and TRUNCATE any torn tail so a
     subsequent append-open continues at the good prefix.  Without the
     truncation, records appended after a crash would land behind the garbage
-    and be unreachable by the next replay — silently losing acked writes."""
+    and be unreachable by the next replay — silently losing acked writes.
+    ``truncate=False`` is the read-only mode (offline viewers)."""
     import os
 
     if not os.path.exists(path):
@@ -53,7 +54,7 @@ def recover(path: str) -> list[bytes]:
     with open(path, "rb") as f:
         data = f.read()
     payloads, good_len = scan(data)
-    if good_len < len(data):
+    if truncate and good_len < len(data):
         with open(path, "r+b") as f:
             f.truncate(good_len)
     return payloads

@@ -20,7 +20,11 @@ import subprocess
 import sys
 
 _WRONG_ENV = (os.environ.get("HDRF_TEST_TPU") != "1"
-              and os.environ.get("JAX_PLATFORMS") != "cpu")
+              and (os.environ.get("JAX_PLATFORMS") != "cpu"
+                   # JAX_PLATFORMS=cpu alone is not enough: the axon
+                   # sitecustomize force-registers the tunnel backend
+                   # whenever the pool var is present.
+                   or "PALLAS_AXON_POOL_IPS" in os.environ))
 
 
 def pytest_configure(config):

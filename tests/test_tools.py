@@ -1,0 +1,115 @@
+"""CLI tools (hdrf_tpu/tools/cli.py): dfs ops, dfsadmin, oiv/oev offline
+viewers, and the balancer — the reference's bin/hdfs + DFSAdmin + OIV/OEV +
+Balancer surface."""
+
+import io
+import json
+import time
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+from hdrf_tpu.testing.minicluster import MiniCluster
+from hdrf_tpu.tools import cli
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_datanodes=3, replication=2) as mc:
+        yield mc
+
+
+def run(argv) -> tuple[int, str]:
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(argv)
+    return rc, buf.getvalue()
+
+
+def nn_arg(mc) -> str:
+    return f"{mc.namenode.addr[0]}:{mc.namenode.addr[1]}"
+
+
+class TestDfsCli:
+    def test_put_ls_cat_stat_rm(self, cluster, tmp_path):
+        payload = np.random.default_rng(0).integers(
+            0, 256, size=100_000, dtype=np.uint8).tobytes()
+        local = tmp_path / "in.bin"
+        local.write_bytes(payload)
+        nn = nn_arg(cluster)
+        assert run(["dfs", "--namenode", nn, "-mkdir", "/t"])[0] == 0
+        assert run(["dfs", "--namenode", nn, "--scheme", "dedup_lz4",
+                    "-put", str(local), "/t/f"])[0] == 0
+        rc, out = run(["dfs", "--namenode", nn, "-ls", "/t"])
+        assert rc == 0 and "f" in out
+        rc, out = run(["dfs", "--namenode", nn, "-stat", "/t/f"])
+        assert rc == 0 and json.loads(out)["length"] == len(payload)
+        out_file = tmp_path / "out.bin"
+        assert run(["dfs", "--namenode", nn, "-get", "/t/f",
+                    str(out_file)])[0] == 0
+        assert out_file.read_bytes() == payload
+        rc, out = run(["dfs", "--namenode", nn, "-du", "/t"])
+        assert rc == 0 and int(out.strip()) == len(payload)
+        assert run(["dfs", "--namenode", nn, "-mv", "/t/f", "/t/g"])[0] == 0
+        assert run(["dfs", "--namenode", nn, "-rm", "/t/g"])[0] == 0
+        assert run(["dfs", "--namenode", nn, "-rm", "/t/g"])[0] == 1
+
+    def test_dfsadmin_report_and_metrics(self, cluster):
+        nn = nn_arg(cluster)
+        rc, out = run(["dfsadmin", "--namenode", nn, "-report"])
+        assert rc == 0 and out.count("live") == 3
+        rc, out = run(["dfsadmin", "--namenode", nn, "-metrics"])
+        assert rc == 0 and "namenode" in json.loads(out)
+        assert run(["dfsadmin", "--namenode", nn, "-savenamespace"])[0] == 0
+
+
+class TestOfflineViewers:
+    def test_oiv_oev(self, cluster, tmp_path):
+        nn = nn_arg(cluster)
+        with cluster.client("viewer") as c:
+            c.write("/viewer/f", b"x" * 1000)
+        run(["dfsadmin", "--namenode", nn, "-savenamespace"])
+        meta = cluster.nn_config.meta_dir
+        rc, out = run(["oiv", meta])
+        assert rc == 0
+        lines = [json.loads(line) for line in out.splitlines()]
+        assert any(e.get("path") == "/viewer/f" for e in lines)
+        with cluster.client("viewer2") as c:
+            c.mkdir("/viewer/after-image")
+        rc, out = run(["oev", meta])
+        assert rc == 0
+        recs = [json.loads(line) for line in out.splitlines()]
+        assert any(r["op"] == "mkdir" and r["args"][0] == "/viewer/after-image"
+                   for r in recs)
+
+
+class TestBalancer:
+    def test_balancer_moves_blocks(self):
+        with MiniCluster(n_datanodes=2, replication=1,
+                         block_size=16 * 1024) as mc:
+            nn = nn_arg(mc)
+            rng = np.random.default_rng(7)
+            with mc.client("bal") as c:
+                for i in range(6):
+                    c.write(f"/bal/f{i}",
+                            rng.integers(0, 256, 40_000, dtype=np.uint8)
+                            .tobytes())
+                # boot a new empty DN; everything sits on dn-0/dn-1
+                mc.datanodes.append(mc._make_dn(2).start())
+                mc.wait_for_datanodes(3)
+                rc, out = run(["balancer", "--namenode", nn,
+                               "--threshold", "1", "--batch", "4",
+                               "--wait-s", "1", "--iterations", "6"])
+                assert rc == 0
+                deadline = time.monotonic() + 15
+                while time.monotonic() < deadline:
+                    rep = {d["dn_id"]: d["blocks"]
+                           for d in c.datanode_report() if d["alive"]}
+                    if rep.get("dn-2", 0) > 0:
+                        break
+                    time.sleep(0.3)
+                assert rep.get("dn-2", 0) > 0, rep
+                # data still readable after moves settle
+                for i in range(6):
+                    assert len(c.read(f"/bal/f{i}")) == 40_000
