@@ -1,0 +1,171 @@
+"""In-tree performance harnesses.
+
+Analogs of the reference's test-tree benchmarks:
+
+- ``nn``   — metadata op throughput against an in-process NameNode
+             (NNThroughputBenchmark.java: single-process, no RPC).
+- ``dfs``  — DFS write/read MB/s through a MiniCluster per reduction scheme
+             (BenchmarkThroughput.java).
+- ``ec``   — RS encode/decode MB/s + striped write/read MB/s
+             (ErasureCodeBenchmarkThroughput.java).
+- ``reduction`` — the block-reduction pipeline (what bench.py at the repo
+             root reports to the driver), selectable backend.
+
+Run: ``python -m hdrf_tpu.benchmarks <which> [options]``; each prints
+one JSON object per metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _rate(n: int, t0: float) -> float:
+    return n / (time.perf_counter() - t0)
+
+
+def bench_nn(args) -> None:
+    import tempfile
+
+    from hdrf_tpu.config import NameNodeConfig
+    from hdrf_tpu.server.namenode import NameNode
+
+    with tempfile.TemporaryDirectory() as d:
+        nn = NameNode(NameNodeConfig(meta_dir=d, replication=1))
+        nn.rpc_register_datanode("dn-bench", ["127.0.0.1", 1])
+        n = args.ops
+        t0 = time.perf_counter()
+        for i in range(n):
+            nn.rpc_mkdir(f"/bench/dir{i % 100}/sub{i}")
+        print(json.dumps({"op": "mkdir", "ops_per_s": round(_rate(n, t0))}))
+        t0 = time.perf_counter()
+        for i in range(n):
+            nn.rpc_create(f"/bench/f{i}", client="b")
+            nn.rpc_heartbeat("dn-bench")
+            alloc = nn.rpc_add_block(f"/bench/f{i}", client="b")
+            nn.rpc_complete(f"/bench/f{i}", client="b",
+                            block_lengths={alloc["block_id"]: 1024})
+        print(json.dumps({"op": "create+addBlock+complete",
+                          "ops_per_s": round(_rate(n, t0))}))
+        t0 = time.perf_counter()
+        for i in range(n):
+            nn.rpc_get_block_locations(f"/bench/f{i}")
+        print(json.dumps({"op": "getBlockLocations",
+                          "ops_per_s": round(_rate(n, t0))}))
+        t0 = time.perf_counter()
+        for i in range(n):
+            nn.rpc_delete(f"/bench/f{i}")
+        print(json.dumps({"op": "delete", "ops_per_s": round(_rate(n, t0))}))
+        nn._editlog.close()
+
+
+def bench_dfs(args) -> None:
+    from hdrf_tpu.testing.minicluster import MiniCluster
+
+    rng = np.random.default_rng(42)
+    n = args.mb << 20
+    payload = rng.integers(0, 256, size=n, dtype=np.uint8)
+    payload[: n // 2] = rng.integers(97, 123, size=n // 2, dtype=np.uint8)
+    payload = payload.tobytes()
+    with MiniCluster(n_datanodes=args.datanodes, replication=args.replication,
+                     block_size=8 << 20) as mc:
+        with mc.client("bench") as c:
+            for scheme in args.schemes.split(","):
+                t0 = time.perf_counter()
+                c.write(f"/bench/{scheme}", payload, scheme=scheme)
+                w = n / (time.perf_counter() - t0) / 2**20
+                t0 = time.perf_counter()
+                got = c.read(f"/bench/{scheme}")
+                r = n / (time.perf_counter() - t0) / 2**20
+                assert got == payload
+                print(json.dumps({"scheme": scheme,
+                                  "write_MBps": round(w, 1),
+                                  "read_MBps": round(r, 1)}))
+
+
+def bench_ec(args) -> None:
+    from hdrf_tpu.ops import rs
+    from hdrf_tpu.testing.minicluster import MiniCluster
+
+    k, m, cell = rs.parse_policy(args.policy)
+    rng = np.random.default_rng(7)
+    L = (args.mb << 20) // k
+    data = rng.integers(0, 256, size=(k, L), dtype=np.uint8)
+    parity = rs.rs_encode(data, k, m)  # warm/compile
+    t0 = time.perf_counter()
+    parity = rs.rs_encode(data, k, m)
+    enc = k * L / (time.perf_counter() - t0) / 2**20
+    shards = {i: data[i] for i in range(k)} | {k + i: parity[i]
+                                              for i in range(m)}
+    for i in range(m):
+        del shards[i]
+    t0 = time.perf_counter()
+    rs.rs_decode(shards, k, m)
+    dec = k * L / (time.perf_counter() - t0) / 2**20
+    print(json.dumps({"op": f"rs_encode {args.policy}",
+                      "MBps": round(enc, 1)}))
+    print(json.dumps({"op": f"rs_decode {m} erasures",
+                      "MBps": round(dec, 1)}))
+    payload = data.tobytes()
+    with MiniCluster(n_datanodes=k + m, block_size=4 << 20) as mc:
+        with mc.client("ecbench") as c:
+            t0 = time.perf_counter()
+            c.write("/bench/ec", payload, ec=args.policy)
+            w = len(payload) / (time.perf_counter() - t0) / 2**20
+            t0 = time.perf_counter()
+            assert c.read("/bench/ec") == payload
+            r = len(payload) / (time.perf_counter() - t0) / 2**20
+    print(json.dumps({"op": f"striped write {args.policy}",
+                      "MBps": round(w, 1)}))
+    print(json.dumps({"op": "striped read", "MBps": round(r, 1)}))
+
+
+def bench_reduction(args) -> None:
+    from hdrf_tpu.config import CdcConfig
+    from hdrf_tpu.ops import dispatch
+
+    rng = np.random.default_rng(3)
+    n = args.mb << 20
+    data = rng.integers(0, 256, size=n, dtype=np.uint8)
+    cdc = CdcConfig()
+    backend = dispatch.resolve_backend(args.backend)
+    dispatch.chunk_and_fingerprint(data[: 1 << 20], cdc, backend)  # warm
+    t0 = time.perf_counter()
+    cuts, digs = dispatch.chunk_and_fingerprint(data, cdc, backend)
+    mbps = n / (time.perf_counter() - t0) / 2**20
+    print(json.dumps({"op": f"reduction pipeline [{backend}]",
+                      "MBps": round(mbps, 1), "chunks": int(cuts.size)}))
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="hdrf-bench")
+    sub = p.add_subparsers(dest="which", required=True)
+    d = sub.add_parser("nn")
+    d.add_argument("--ops", type=int, default=5000)
+    d.set_defaults(fn=bench_nn)
+    d = sub.add_parser("dfs")
+    d.add_argument("--mb", type=int, default=64)
+    d.add_argument("--datanodes", type=int, default=3)
+    d.add_argument("--replication", type=int, default=2)
+    d.add_argument("--schemes", default="direct,lz4,dedup_lz4")
+    d.set_defaults(fn=bench_dfs)
+    d = sub.add_parser("ec")
+    d.add_argument("--mb", type=int, default=48)
+    d.add_argument("--policy", default="rs-6-3-64k")
+    d.set_defaults(fn=bench_ec)
+    d = sub.add_parser("reduction")
+    d.add_argument("--mb", type=int, default=64)
+    d.add_argument("--backend", default="auto")
+    d.set_defaults(fn=bench_reduction)
+    args = p.parse_args(argv)
+    args.fn(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,11 +35,20 @@ _TR = tracing.tracer("client")
 
 
 class HdrfClient:
-    def __init__(self, namenode_addr: tuple[str, int],
+    def __init__(self, namenode_addr,
                  config: ClientConfig | None = None, name: str | None = None):
+        """``namenode_addr``: one (host, port) or an ordered list of them —
+        a list engages the HA failover proxy (retry across NNs on
+        StandbyError / connection failure)."""
         self.config = config or ClientConfig()
         self.name = name or f"client-{uuid.uuid4().hex[:8]}"
-        self._nn = RpcClient(namenode_addr)
+        if isinstance(namenode_addr, (list,)) and namenode_addr \
+                and isinstance(namenode_addr[0], (list, tuple)):
+            from hdrf_tpu.proto.rpc import HaRpcClient
+
+            self._nn = HaRpcClient([tuple(a) for a in namenode_addr])
+        else:
+            self._nn = RpcClient(tuple(namenode_addr))
 
     def close(self) -> None:
         self._nn.close()
@@ -77,6 +86,29 @@ class HdrfClient:
     def datanode_report(self) -> list[dict]:
         return self._nn.call("datanode_report")
 
+    # ------------------------------------------------- snapshots and quotas
+
+    def allow_snapshot(self, path: str) -> bool:
+        return self._nn.call("allow_snapshot", path=path)
+
+    def create_snapshot(self, path: str, name: str) -> bool:
+        return self._nn.call("create_snapshot", path=path, name=name)
+
+    def delete_snapshot(self, path: str, name: str) -> bool:
+        return self._nn.call("delete_snapshot", path=path, name=name)
+
+    def list_snapshots(self, path: str) -> list[str]:
+        return self._nn.call("list_snapshots", path=path)
+
+    def set_quota(self, path: str, namespace_quota: int = -1,
+                  space_quota: int = -1) -> bool:
+        return self._nn.call("set_quota", path=path,
+                             namespace_quota=namespace_quota,
+                             space_quota=space_quota)
+
+    def content_summary(self, path: str) -> dict:
+        return self._nn.call("content_summary", path=path)
+
     # ----------------------------------------------------------------- write
 
     def write(self, path: str, data: bytes, scheme: str | None = None,
@@ -105,10 +137,24 @@ class HdrfClient:
                 off += block_size
                 if off >= len(data):
                     break
-            self._nn.call("complete", path=path, client=self.name,
-                          block_lengths=lengths)
+            self._complete(path, lengths)
             _M.incr("files_written")
             _M.incr("bytes_written", len(data))
+
+    def _complete(self, path: str, lengths: dict[int, int],
+                  timeout: float = 30.0) -> None:
+        """completeFile retry loop: the NN answers False until every block
+        has a reported location (IBRs are asynchronous)."""
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        while True:
+            if self._nn.call("complete", path=path, client=self.name,
+                             block_lengths=lengths):
+                return
+            if _t.monotonic() > deadline:
+                raise IOError(f"complete({path}) timed out awaiting replicas")
+            _t.sleep(0.05)
 
     def _write_block(self, path: str, block: bytes, retries: int = 3) -> int:
         last_err: Exception | None = None

@@ -77,8 +77,7 @@ class StripedWriter:
             off += group_capacity
             if off >= len(data):
                 break
-        c._nn.call("complete", path=path, client=c.name,
-                   block_lengths=lengths)
+        c._complete(path, lengths)
         _M.incr("ec_files_written")
         _M.incr("ec_bytes_written", len(data))
 

@@ -86,6 +86,11 @@ class NameNodeConfig:
     # monitor re-queues it (PendingReconstructionBlocks timeout analog).
     pending_replication_timeout_s: float = 30.0
     editlog_checkpoint_every: int = 1000  # ops between auto-checkpoints
+    # HA: "active" serves + writes the journal; "standby" tails it read-only
+    # and answers (possibly slightly stale) reads until failover.
+    role: str = "active"
+    # Standby journal catch-up cadence (EditLogTailer interval analog).
+    tail_interval_s: float = 0.5
 
 
 @dataclass

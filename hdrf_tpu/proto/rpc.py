@@ -143,6 +143,48 @@ class RpcServer:
             s.close()
 
 
+class HaRpcClient:
+    """Failover proxy over an ordered NN list (the reference's
+    ConfiguredFailoverProxyProvider + RetryProxy analog): on connection
+    failure or StandbyError, rotate to the next address; remember the last
+    good one."""
+
+    RETRIABLE = ("StandbyError",)
+
+    def __init__(self, addrs: list[tuple[str, int]], timeout: float = 30.0):
+        self._clients = [RpcClient(a, timeout) for a in addrs]
+        self._cur = 0
+
+    def call(self, method: str, **kwargs: Any) -> Any:
+        last: Exception | None = None
+        for attempt in range(2 * len(self._clients)):
+            c = self._clients[self._cur]
+            try:
+                return c.call(method, **kwargs)
+            except (ConnectionError, OSError) as e:
+                last = e
+            except RpcError as e:
+                if e.error not in self.RETRIABLE:
+                    raise
+                last = e
+            self._cur = (self._cur + 1) % len(self._clients)
+            if attempt >= len(self._clients):
+                import time as _t
+
+                _t.sleep(0.2)  # second lap: give a failover a beat to land
+        raise ConnectionError(f"all namenodes failed: {last}")
+
+    def close(self) -> None:
+        for c in self._clients:
+            c.close()
+
+    def __enter__(self) -> "HaRpcClient":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
 class RpcClient:
     """Blocking RPC client; one socket, requests serialized by a lock.
     Reconnects on the next call after a connection failure."""

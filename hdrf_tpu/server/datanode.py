@@ -48,8 +48,12 @@ _M = metrics.registry("datanode")
 
 
 class DataNode:
-    def __init__(self, config: DataNodeConfig, namenode_addr: tuple[str, int],
+    def __init__(self, config: DataNodeConfig, namenode_addr,
                  dn_id: str | None = None):
+        """``namenode_addr``: one (host, port) or a list of them — with HA the
+        DN reports to EVERY NameNode (the BPOfferService-per-NN pattern: the
+        standby needs block reports too, so its block map is warm at
+        failover) but executes commands only from the active."""
         self.config = config
         self.checksum_chunk = 64 * 1024
         red = config.reduction
@@ -67,11 +71,19 @@ class DataNode:
         self._read_sem = threading.Semaphore(red.max_concurrent_reads)
         self._direct_sem = threading.Semaphore(red.max_concurrent_direct)
         self.dn_id = dn_id or f"dn-{uuid.uuid4().hex[:8]}"
-        self._nn = RpcClient(namenode_addr)
+        if (isinstance(namenode_addr, list) and namenode_addr
+                and isinstance(namenode_addr[0], (list, tuple))):
+            addrs = [tuple(a) for a in namenode_addr]
+        else:
+            addrs = [tuple(namenode_addr)]
+        self._nns = [RpcClient(a) for a in addrs]
+        self._nn = self._nns[0]  # convenience for single-NN paths
         self._receiver = BlockReceiver(self)
         self._sender = BlockSender(self)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._ibr_queue: list[tuple[int, int]] = []
+        self._ibr_event = threading.Event()
 
         outer = self
 
@@ -110,6 +122,10 @@ class DataNode:
                               name=f"{self.dn_id}-heartbeat", daemon=True)
         hb.start()
         self._threads.append(hb)
+        ibr = threading.Thread(target=self._ibr_loop,
+                               name=f"{self.dn_id}-ibr", daemon=True)
+        ibr.start()
+        self._threads.append(ibr)
         if self.config.scan_interval_s > 0:
             sc = threading.Thread(target=self._scanner_loop,
                                   name=f"{self.dn_id}-scanner", daemon=True)
@@ -127,7 +143,8 @@ class DataNode:
             t.join(timeout=5)
         self.containers.flush_open(on_seal=self.index.seal_container)
         self.index.close()
-        self._nn.close()
+        for nn in self._nns:
+            nn.close()
 
     def _sever_connections(self) -> None:
         for s in list(self._conns):
@@ -170,13 +187,25 @@ class DataNode:
             self._read_sem.release()
 
     def notify_block_received(self, block_id: int, length: int) -> None:
-        """Incremental block report (IBR) on finalize; best-effort — the
-        periodic full report reconciles anything missed."""
-        try:
-            self._nn.call("block_received", dn_id=self.dn_id,
-                          block_id=block_id, length=length)
-        except (OSError, ConnectionError):
-            _M.incr("ibr_failures")
+        """Incremental block report (IBR) on finalize: queued and delivered
+        by a dedicated thread so an unreachable NN can never stall the write
+        pipeline's ack (HDFS IBRs are asynchronous for the same reason);
+        best-effort — the periodic full report reconciles anything missed."""
+        self._ibr_queue.append((block_id, length))
+        self._ibr_event.set()
+
+    def _ibr_loop(self) -> None:
+        while not self._stop.is_set():
+            self._ibr_event.wait(timeout=0.5)
+            self._ibr_event.clear()
+            while self._ibr_queue:
+                block_id, length = self._ibr_queue.pop(0)
+                for nn in self._nns:
+                    try:
+                        nn.call("block_received", dn_id=self.dn_id,
+                                block_id=block_id, length=length)
+                    except (OSError, ConnectionError):
+                        _M.incr("ibr_failures")
 
     # ---------------------------------------------------------- xceiver loop
 
@@ -222,14 +251,30 @@ class DataNode:
 
     # ------------------------------------------------------- NN interaction
 
-    def _register(self) -> None:
-        self._nn.call("register_datanode", dn_id=self.dn_id,
-                      addr=list(self.addr), sc_path=self._sc.path)
-        self._send_block_report()
+    def _register(self, nn: RpcClient | None = None) -> None:
+        """Per-NN error isolation: one dead NN (e.g. the old active after a
+        failover) must not block registration/reports to the live ones."""
+        ok = 0
+        for c in ([nn] if nn else self._nns):
+            try:
+                c.call("register_datanode", dn_id=self.dn_id,
+                       addr=list(self.addr), sc_path=self._sc.path)
+                self._send_block_report(c)
+                ok += 1
+            except (OSError, ConnectionError):
+                _M.incr("register_failures")
+        if ok == 0 and nn is None:
+            raise ConnectionError("no namenode reachable at registration")
 
-    def _send_block_report(self) -> None:
-        self._nn.call("block_report", dn_id=self.dn_id,
-                      blocks=[list(t) for t in self.replicas.block_report()])
+    def _send_block_report(self, nn: RpcClient | None = None) -> None:
+        report = [list(t) for t in self.replicas.block_report()]
+        for c in ([nn] if nn else self._nns):
+            try:
+                c.call("block_report", dn_id=self.dn_id, blocks=report)
+            except (OSError, ConnectionError):
+                if nn is not None:
+                    raise  # caller handles (registration path)
+                _M.incr("block_report_failures")
 
     def _heartbeat_loop(self) -> None:
         interval = self.config.heartbeat_interval_s
@@ -237,23 +282,28 @@ class DataNode:
         import time as _time
 
         while not self._stop.wait(interval):
-            try:
-                fault_injection.point("datanode.heartbeat", dn_id=self.dn_id)
-                resp = self._nn.call("heartbeat", dn_id=self.dn_id,
-                                     stats=self._stats())
-                if resp.get("reregister"):
-                    self._register()
-                    continue
-                for cmd in resp.get("commands", []):
-                    self._execute(cmd)
-                now = _time.monotonic()
-                if now - last_report > self.config.block_report_interval_s:
+            fault_injection.point("datanode.heartbeat", dn_id=self.dn_id)
+            stats = self._stats()
+            for nn in self._nns:
+                try:
+                    resp = nn.call("heartbeat", dn_id=self.dn_id, stats=stats)
+                    if resp.get("reregister"):
+                        self._register(nn)
+                        continue
+                    # only the active commands; a standby answers with none
+                    for cmd in resp.get("commands", []):
+                        self._execute(cmd)
+                except (OSError, ConnectionError):
+                    _M.incr("heartbeat_failures")
+                except Exception:  # noqa: BLE001
+                    _M.incr("command_errors")
+            now = _time.monotonic()
+            if now - last_report > self.config.block_report_interval_s:
+                try:
                     self._send_block_report()
-                    last_report = now
-            except (OSError, ConnectionError):
-                _M.incr("heartbeat_failures")
-            except Exception:  # noqa: BLE001
-                _M.incr("command_errors")
+                except (OSError, ConnectionError):
+                    _M.incr("heartbeat_failures")
+                last_report = now
 
     def _stats(self) -> dict:
         return {
@@ -361,7 +411,12 @@ class DataNode:
                 bad = self.verify_block(bid)
                 if bad:
                     _M.incr("scanner_corrupt_found")
-                    self._nn.call("bad_block", dn_id=self.dn_id, block_id=bid)
+                    for nn in self._nns:
+                        try:
+                            nn.call("bad_block", dn_id=self.dn_id,
+                                    block_id=bid)
+                        except (OSError, ConnectionError):
+                            _M.incr("scanner_errors")
                     self._invalidate(bid)
             except (OSError, ConnectionError):
                 _M.incr("scanner_errors")

@@ -24,6 +24,12 @@ from hdrf_tpu.utils import fault_injection, wal as walmod
 WAL_NAME = "edits.wal"
 IMG_NAME = "fsimage"
 IMG_TMP = "fsimage.tmp"
+EPOCH_NAME = "epoch"
+
+
+class FencedError(Exception):
+    """This NameNode's epoch is stale: another NN has transitioned to active
+    (the QJM epoch-fencing analog — writers with an old epoch are rejected)."""
 
 
 class EditLog:
@@ -35,6 +41,31 @@ class EditLog:
         self._checkpoint_every = checkpoint_every
         self._snapshot_fn: Callable[[], Any] | None = None
         self._wal = None  # opened after recovery
+        self._epoch: int | None = None  # writer epoch once active
+
+    # ----------------------------------------------------------- HA fencing
+
+    def read_epoch(self) -> int:
+        try:
+            with open(os.path.join(self._dir, EPOCH_NAME)) as f:
+                return int(f.read().strip() or 0)
+        except FileNotFoundError:
+            return 0
+
+    def claim_epoch(self) -> int:
+        """Become the writer: bump the shared epoch under the journal lock
+        (serialized against in-flight appends); any previous writer's next
+        append sees the newer epoch and gets FencedError."""
+        with self._fence_lock():
+            e = self.read_epoch() + 1
+            tmp = os.path.join(self._dir, EPOCH_NAME + ".tmp")
+            with open(tmp, "w") as f:
+                f.write(str(e))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self._dir, EPOCH_NAME))
+        self._epoch = e
+        return e
 
     # -------------------------------------------------------------- recovery
 
@@ -49,13 +80,17 @@ class EditLog:
         self.seq = seq
         return snapshot
 
-    def replay(self, apply_fn: Callable[[list], None]) -> int:
+    def replay(self, apply_fn: Callable[[list], None],
+               readonly: bool = False) -> int:
         """Replay WAL records newer than the image; returns count applied.
         Call once, after load_image, before open_for_append.  recover()
         truncates any torn tail so open_for_append continues at the good
-        prefix (appending behind garbage would lose acked edits)."""
+        prefix (appending behind garbage would lose acked edits); a standby
+        tailer passes ``readonly`` — it must never truncate the active's WAL
+        mid-append (the tail it sees as torn may still be in flight)."""
         n = 0
-        for payload in walmod.recover(os.path.join(self._dir, WAL_NAME)):
+        for payload in walmod.recover(os.path.join(self._dir, WAL_NAME),
+                                      truncate=not readonly):
             seq, *rec = msgpack.unpackb(payload, raw=False, use_list=True,
                                         strict_map_key=False)
             if seq > self.seq:
@@ -63,6 +98,21 @@ class EditLog:
                 self.seq = seq
                 n += 1
         return n
+
+    def tail(self, apply_fn: Callable[[list], None],
+             reload_fn: Callable[[Any], None] | None = None) -> int:
+        """Standby-side incremental catch-up (EditLogTailer.java:74 analog):
+        if the active has published a newer fsimage (its checkpoint truncated
+        the WAL), reload it first, then apply WAL records past ``seq``."""
+        img = os.path.join(self._dir, IMG_NAME)
+        if os.path.exists(img) and reload_fn is not None:
+            with open(img, "rb") as f:
+                seq, snapshot = msgpack.unpackb(
+                    f.read(), raw=False, use_list=True, strict_map_key=False)
+            if seq > self.seq:
+                reload_fn(snapshot)
+                self.seq = seq
+        return self.replay(apply_fn, readonly=True)
 
     def open_for_append(self, snapshot_fn: Callable[[], Any]) -> None:
         """``snapshot_fn`` is called at auto-checkpoint time to capture the
@@ -72,14 +122,30 @@ class EditLog:
 
     # --------------------------------------------------------------- logging
 
+    def _fence_lock(self):
+        """An flock'd handle on the shared lock file.  Held across
+        epoch-check + WAL write so a concurrent claim_epoch (which takes the
+        same lock) cannot interleave — without it a fenced writer could slip
+        one record into the journal between its check and its write, and its
+        seq would collide with the new active's next acked edit."""
+        import fcntl
+
+        f = open(os.path.join(self._dir, "journal.lock"), "a+")
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        return f
+
     def append(self, rec: list) -> None:
         """Durably log one mutation (logSync analog — every record is fsync'd;
         the reference's group commit batching is future work)."""
         payload = msgpack.packb([self.seq + 1, *rec])
         fault_injection.point("editlog.append")
-        self._wal.write(walmod.frame(payload))
-        self._wal.flush()
-        os.fsync(self._wal.fileno())
+        with self._fence_lock():
+            if self._epoch is not None and self.read_epoch() != self._epoch:
+                raise FencedError(
+                    f"epoch {self._epoch} superseded by {self.read_epoch()}")
+            self._wal.write(walmod.frame(payload))
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
         self.seq += 1
         self._ops_since_ckpt += 1
         if self._ops_since_ckpt >= self._checkpoint_every:

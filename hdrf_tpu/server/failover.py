@@ -1,0 +1,76 @@
+"""Automatic failover controller (the ZKFC analog, minus ZooKeeper).
+
+The reference's DFSZKFailoverController watches NN health via RPC and uses a
+ZooKeeper leader lock to coordinate who promotes whom (HAZKInfo.proto).  Here
+the shared journal's epoch IS the lock (editlog.claim_epoch fences the old
+writer), so the controller only needs health checking + a promote call:
+poll every NN's ha_state; if no active answers for ``grace`` consecutive
+probes, transition the first healthy standby.  Safe under split brain by
+construction — two controllers racing both call transition_to_active, the
+second claim_epoch wins, the first active gets fenced on its next append.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from hdrf_tpu.proto.rpc import RpcClient
+from hdrf_tpu.utils import metrics
+
+_M = metrics.registry("failover")
+
+
+class FailoverController:
+    def __init__(self, nn_addrs: list[tuple[str, int]],
+                 probe_interval_s: float = 1.0, grace: int = 3):
+        self._addrs = [tuple(a) for a in nn_addrs]
+        self._interval = probe_interval_s
+        self._grace = grace
+        self._misses = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name="zkfc",
+                                        daemon=True)
+
+    def start(self) -> "FailoverController":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def probe(self) -> tuple[bool, list[tuple[tuple, str]]]:
+        """(active_alive, [(addr, role) for each reachable NN])."""
+        states = []
+        active_alive = False
+        for addr in self._addrs:
+            try:
+                with RpcClient(addr, timeout=2.0) as c:
+                    st = c.call("ha_state")
+                states.append((addr, st["role"]))
+                if st["role"] == "active":
+                    active_alive = True
+            except (OSError, ConnectionError):
+                continue
+        return active_alive, states
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                active_alive, states = self.probe()
+                if active_alive:
+                    self._misses = 0
+                    continue
+                self._misses += 1
+                _M.incr("active_misses")
+                if self._misses < self._grace:
+                    continue
+                for addr, role in states:
+                    if role == "standby":
+                        with RpcClient(addr, timeout=5.0) as c:
+                            c.call("transition_to_active")
+                        _M.incr("failovers_triggered")
+                        self._misses = 0
+                        break
+            except Exception:  # noqa: BLE001 — controller must survive
+                _M.incr("controller_errors")

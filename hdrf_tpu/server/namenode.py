@@ -123,9 +123,15 @@ class LeaseManager:
                 del self._leases[path]
 
 
+class StandbyError(Exception):
+    """Mutating RPC hit a standby NameNode (StandbyException analog) — the
+    HA client proxy fails over to the next NN on this."""
+
+
 class NameNode:
     def __init__(self, config: NameNodeConfig | None = None):
         self.config = config or NameNodeConfig()
+        self.role = self.config.role  # "active" | "standby"
         self._lock = threading.RLock()  # the FSNamesystem lock analog
         # namespace: nested dict tree; leaves are FileNode
         self._root: dict[str, Any] = {}
@@ -135,6 +141,17 @@ class NameNode:
         self._leases = LeaseManager()
         self._pending_repl: dict[int, float] = {}  # block_id -> retry deadline
         self._pending_moves: dict[int, str] = {}   # balancer: block -> old DN
+        self._pending_ibr: dict[int, list] = {}    # standby: IBRs ahead of tail
+        # Snapshots: frozen subtree images per snapshottable dir
+        # (namenode/snapshot analog; blocks are immutable once complete, so a
+        # structural freeze IS a consistent point-in-time view).
+        self._snapshottable: set[str] = set()
+        self._snapshots: dict[str, dict[str, dict]] = {}  # dir -> name -> tree
+        self._quotas: dict[str, tuple[int, int]] = {}  # dir -> (ns, space)
+        # Cached usage per quota root: [entries, bytes]; None = recompute on
+        # next check (the reference maintains counts on the quota INode for
+        # the same reason: O(subtree) walks per create don't scale).
+        self._qusage: dict[str, list | None] = {}
         self._next_block_id = 1
         self._gen_stamp = 1
         self._editlog = EditLog(self.config.meta_dir,
@@ -148,8 +165,10 @@ class NameNode:
 
     def start(self) -> "NameNode":
         self._rpc.start()
-        self._monitor = threading.Thread(target=self._monitor_loop,
-                                         name="nn-monitor", daemon=True)
+        target = (self._monitor_loop if self.role == "active"
+                  else self._tailer_loop)
+        self._monitor = threading.Thread(target=target, name="nn-monitor",
+                                         daemon=True)
         self._monitor.start()
         return self
 
@@ -170,8 +189,25 @@ class NameNode:
         snap = self._editlog.load_image()
         if snap is not None:
             self._restore(snap)
-        self._editlog.replay(self._apply_tolerant)
-        self._editlog.open_for_append(self._snapshot)
+        if self.role == "standby":
+            # tail-only: never truncate or append to the active's journal
+            self._editlog.replay(self._apply_tolerant, readonly=True)
+        else:
+            self._editlog.replay(self._apply_tolerant)
+            self._editlog.claim_epoch()
+            self._editlog.open_for_append(self._snapshot)
+
+    def _reload_image(self, snap: dict) -> None:
+        """Standby-side fsimage reload (the active checkpointed): _restore
+        rebuilds BlockInfos with empty location sets, so re-seed them from
+        the DN-report-built map — the warm block map is the whole point of
+        a hot standby."""
+        old_locs = {bid: info.locations for bid, info in self._blocks.items()}
+        self._restore(snap)
+        for bid, locs in old_locs.items():
+            info = self._blocks.get(bid)
+            if info is not None:
+                info.locations |= locs
 
     def _apply_tolerant(self, rec: list) -> None:
         """Replay-path apply: a record that no longer applies (e.g. the WAL
@@ -202,6 +238,9 @@ class NameNode:
                        for g in self._groups.values()},
             "next_block_id": self._next_block_id,
             "gen_stamp": self._gen_stamp,
+            "snapshottable": sorted(self._snapshottable),
+            "snapshots": self._snapshots,
+            "quotas": {p: list(q) for p, q in self._quotas.items()},
         }
 
     def _restore(self, snap: dict) -> None:
@@ -220,6 +259,10 @@ class NameNode:
                         for bid, (gs, ln, path) in snap["blocks"].items()}
         self._groups = {gid: GroupInfo(gid, list(bids), ln)
                         for gid, (bids, ln) in snap.get("groups", {}).items()}
+        self._snapshottable = set(snap.get("snapshottable", []))
+        self._snapshots = snap.get("snapshots", {})
+        self._quotas = {p: tuple(q)
+                        for p, q in snap.get("quotas", {}).items()}
         self._next_block_id = snap["next_block_id"]
         self._gen_stamp = snap["gen_stamp"]
 
@@ -269,6 +312,57 @@ class NameNode:
             self._delete_apply(rec[1])
         elif op == "rename":
             self._rename_apply(rec[1], rec[2])
+        elif op == "allow_snapshot":
+            path = "/" + "/".join(self._parts(rec[1]))
+            self._snapshottable.add(path)
+            self._snapshots.setdefault(path, {})
+        elif op == "create_snapshot":
+            _, path, name = rec
+            path = "/" + "/".join(self._parts(path))
+            node = self._resolve(path)
+            self._snapshots.setdefault(path, {})[name] = self._freeze(node)
+        elif op == "delete_snapshot":
+            self._delete_snapshot_apply(rec[1], rec[2])
+        elif op == "set_quota":
+            _, path, ns_q, sp_q = rec
+            path = "/" + "/".join(self._parts(path))
+            if ns_q < 0 and sp_q < 0:
+                self._quotas.pop(path, None)
+                self._qusage.pop(path, None)
+            else:
+                self._quotas[path] = (ns_q, sp_q)
+                self._qusage[path] = None  # seed lazily
+
+    def _account(self, rec: list) -> None:
+        """Keep cached quota usage in sync with an applied edit.  Cheap ops
+        adjust incrementally; structural ops (delete/rename/snapshots) mark
+        affected roots dirty for lazy recount."""
+        if not self._quotas:
+            return
+        op = rec[0]
+        if op == "mkdir":
+            for r, _ in self._quota_roots_of(rec[1]):
+                self._qusage[r] = None  # created-count unknown: recount lazily
+        elif op == "create":
+            for r, _ in self._quota_roots_of(rec[1]):
+                u = self._qusage.get(r)
+                if u is not None:
+                    u[0] += 1
+                else:
+                    self._qusage[r] = None
+        elif op == "complete":
+            # delta vs lengths already known (an IBR may have set a block's
+            # length before complete — don't double count)
+            add = rec[-1]  # precomputed by _log before apply
+            for r, _ in self._quota_roots_of(rec[1]):
+                u = self._qusage.get(r)
+                if u is not None:
+                    u[1] += add
+        elif op in ("delete", "rename", "delete_snapshot"):
+            for path in (rec[1], rec[2] if op == "rename" else rec[1]):
+                if isinstance(path, str):
+                    for r, _ in self._quota_roots_of(path):
+                        self._qusage[r] = None
 
     def _log(self, rec: list) -> None:
         """Validate, then append, then apply.  Validation (non-mutating)
@@ -278,9 +372,40 @@ class NameNode:
         durability discipline (editlog.py): if the append raises, memory is
         untouched and the client sees the error; if apply then raises, WAL
         and memory agree again after a restart replays the record."""
+        from hdrf_tpu.server.editlog import FencedError
+
+        if self.role != "active":
+            raise StandbyError("namenode is standby")
         self._validate(rec)
-        self._editlog.append(rec)
-        self._apply(rec)
+        try:
+            self._editlog.append(rec)
+        except FencedError:
+            # another NN claimed the journal: demote (old-active fencing)
+            self._demote()
+            raise StandbyError("namenode fenced: now standby") from None
+        if rec[0] == "complete" and self._quotas:
+            delta = 0
+            for bid, ln in rec[2].items():
+                if bid in self._groups:
+                    prev = self._groups[bid].logical_len
+                elif bid in self._blocks:
+                    prev = self._blocks[bid].length
+                else:
+                    continue
+                delta += ln - max(prev, 0)
+            self._apply(rec)
+            self._account(rec + [delta])
+        else:
+            self._apply(rec)
+            self._account(rec)
+
+    def _demote(self) -> None:
+        self.role = "standby"
+        self._editlog.close()
+        tailer = threading.Thread(target=self._tailer_loop,
+                                  name="nn-tailer", daemon=True)
+        tailer.start()  # the running monitor loop exits on its role check
+        _M.incr("demotions")
 
     def _peek_parent(self, path: str) -> tuple[dict | None, str]:
         """Non-mutating walk to ``path``'s parent: raises if a component is a
@@ -321,6 +446,22 @@ class NameNode:
             dparent, dname = self._peek_parent(rec[2])
             if dparent is not None and dname in dparent:
                 raise FileExistsError(rec[2])
+        elif op == "allow_snapshot":
+            if not isinstance(self._resolve(rec[1]), dict):
+                raise NotADirectoryError(rec[1])
+        elif op == "create_snapshot":
+            p = "/" + "/".join(self._parts(rec[1]))
+            if p not in self._snapshottable:
+                raise PermissionError(f"{p} is not snapshottable")
+            if rec[2] in self._snapshots.get(p, {}):
+                raise FileExistsError(f"snapshot {rec[2]} exists")
+        elif op == "delete_snapshot":
+            p = "/" + "/".join(self._parts(rec[1]))
+            if rec[2] not in self._snapshots.get(p, {}):
+                raise FileNotFoundError(f"no snapshot {rec[2]} of {p}")
+        elif op == "set_quota":
+            if not isinstance(self._resolve(rec[1]), dict):
+                raise NotADirectoryError(rec[1])
 
     # ------------------------------------------------------- tree utilities
 
@@ -347,12 +488,36 @@ class NameNode:
 
     def _resolve(self, path: str) -> Any:
         parts = [p for p in path.split("/") if p]
+        if ".snapshot" in parts:
+            return self._resolve_snapshot(parts)
         node: Any = self._root
         for p in parts:
             if isinstance(node, FileNode):
                 raise NotADirectoryError(path)
             if p not in node:
                 raise FileNotFoundError(path)
+            node = node[p]
+        return node
+
+    def _resolve_snapshot(self, parts: list[str]) -> Any:
+        """Resolve ``<dir>/.snapshot[/<name>[/rest...]]`` through the frozen
+        trees (the /.snapshot virtual-directory convention)."""
+        i = parts.index(".snapshot")
+        droot = "/" + "/".join(parts[:i])
+        snaps = self._snapshots.get(droot)
+        if snaps is None:
+            raise FileNotFoundError(f"{droot} is not snapshottable")
+        rest = parts[i + 1:]
+        if not rest:  # listing /dir/.snapshot -> one dir per snapshot name
+            return {name: self._thaw(tree) for name, tree in snaps.items()}
+        if rest[0] not in snaps:
+            raise FileNotFoundError(f"no snapshot {rest[0]} of {droot}")
+        node = self._thaw(snaps[rest[0]])
+        for p in rest[1:]:
+            if isinstance(node, FileNode):
+                raise NotADirectoryError("/".join(parts))
+            if p not in node:
+                raise FileNotFoundError("/".join(parts))
             node = node[p]
         return node
 
@@ -375,21 +540,101 @@ class NameNode:
     def _delete_apply(self, path: str) -> None:
         parent, name = self._parent_of(path)
         node = parent.pop(name, None)
+        kept = self._snapshot_referenced()  # (block ids, group ids) to keep
         for fn in self._iter_files(node):
-            bids: list[int] = []
-            for bid in fn.blocks:
-                grp = self._groups.pop(bid, None)
-                bids.extend(grp.bids if grp else [bid])
-            for bid in bids:
-                info = self._blocks.pop(bid, None)
-                if info:
-                    for dn_id in info.locations:
-                        dn = self._datanodes.get(dn_id)
-                        if dn:
-                            dn.commands.append({"cmd": "invalidate",
-                                                "block_ids": [bid]})
+            for gb in fn.blocks:
+                grp = self._groups.get(gb)
+                if grp is not None:
+                    if gb in kept[1]:
+                        continue  # a snapshot still references this group
+                    self._groups.pop(gb)
+                    bids = grp.bids
+                else:
+                    bids = [gb]
+                for bid in bids:
+                    if bid in kept[0]:
+                        continue
+                    self._drop_block(bid)
         # in-flight writes anywhere under the deleted path lose their leases
         self._leases.drop_subtree(path)
+
+    def _drop_block(self, bid: int) -> None:
+        info = self._blocks.pop(bid, None)
+        if info:
+            for dn_id in info.locations:
+                dn = self._datanodes.get(dn_id)
+                if dn:
+                    dn.commands.append({"cmd": "invalidate",
+                                        "block_ids": [bid]})
+
+    # ------------------------------------------------------------ snapshots
+
+    @staticmethod
+    def _freeze(node: Any) -> Any:
+        """Live subtree -> the serialized tree form (same layout as the
+        fsimage walk): a consistent point-in-time view, since completed
+        blocks are immutable."""
+        if isinstance(node, FileNode):
+            return ["f", node.replication, node.scheme, list(node.blocks),
+                    node.complete, node.mtime, node.ec]
+        return ["d", {name: NameNode._freeze(child)
+                      for name, child in node.items()}]
+
+    def _thaw(self, v: Any) -> Any:
+        """Frozen form -> read-only live-form objects (for resolution through
+        ``/dir/.snapshot/name/...`` paths)."""
+        if v[0] == "f":
+            return FileNode(v[1], v[2], list(v[3]), v[4], v[5],
+                            v[6] if len(v) > 6 else None)
+        return {name: self._thaw(child) for name, child in v[1].items()}
+
+    def _tree_blocks(self, v: Any) -> tuple[set[int], set[int]]:
+        """(block ids, group ids) referenced by a frozen tree."""
+        bids: set[int] = set()
+        gids: set[int] = set()
+        if v[0] == "f":
+            for gb in v[3]:
+                grp = self._groups.get(gb)
+                if grp is not None:
+                    gids.add(gb)
+                    bids.update(grp.bids)
+                else:
+                    bids.add(gb)
+        else:
+            for child in v[1].values():
+                b, g = self._tree_blocks(child)
+                bids |= b
+                gids |= g
+        return bids, gids
+
+    def _snapshot_referenced(self) -> tuple[set[int], set[int]]:
+        bids: set[int] = set()
+        gids: set[int] = set()
+        for snaps in self._snapshots.values():
+            for tree in snaps.values():
+                b, g = self._tree_blocks(tree)
+                bids |= b
+                gids |= g
+        return bids, gids
+
+    def _delete_snapshot_apply(self, path: str, name: str) -> None:
+        path = "/" + "/".join(self._parts(path))
+        tree = self._snapshots.get(path, {}).pop(name)
+        dead_b, dead_g = self._tree_blocks(tree)
+        live_b, live_g = self._snapshot_referenced()
+        # blocks still reachable from the live namespace also stay
+        for fn in self._iter_files(self._root):
+            for gb in fn.blocks:
+                grp = self._groups.get(gb)
+                if grp is not None:
+                    live_g.add(gb)
+                    live_b.update(grp.bids)
+                else:
+                    live_b.add(gb)
+        for gid in dead_g - live_g:
+            self._groups.pop(gid, None)
+        for bid in dead_b - live_b:
+            self._drop_block(bid)
 
     def _rename_apply(self, src: str, dst: str) -> None:
         sparent, sname = self._parent_of(src)
@@ -417,6 +662,7 @@ class NameNode:
 
     def rpc_mkdir(self, path: str) -> bool:
         with self._lock:
+            self._check_ns_quota(path)
             self._log(["mkdir", path])
             _M.incr("mkdir")
             return True
@@ -436,6 +682,7 @@ class NameNode:
                     raise IsADirectoryError(path)
                 if existing.complete:
                     raise FileExistsError(path)
+            self._check_ns_quota(path)
             self._leases.acquire(path, client)
             if existing is not None:
                 # Overwriting an abandoned incomplete file: drop it first so
@@ -453,6 +700,7 @@ class NameNode:
         with self._lock:
             self._leases.check(path, client)
             node = self._file(path)
+            self._check_space_quota(path, self.config.block_size)
             bid, gs = self._next_block_id, self._gen_stamp
             targets = self._choose_targets(node.replication, exclude=set())
             if not targets:
@@ -474,6 +722,7 @@ class NameNode:
             if not node.ec:
                 raise ValueError(f"{path} is not an EC file")
             k, m, cell = rs.parse_policy(node.ec)
+            self._check_space_quota(path, k * self.config.block_size)
             targets = self._choose_targets(k + m, exclude=set())
             if len(targets) < k + m:
                 # fewer DNs than shards: wrap around (degraded placement;
@@ -500,8 +749,20 @@ class NameNode:
 
     def rpc_complete(self, path: str, client: str,
                      block_lengths: dict[int, int]) -> bool:
+        """False = not yet: some block has no reported location (IBRs are
+        asynchronous); the client retries — completeFile's retry loop in the
+        reference (DFSClient) exists for exactly this, with the NN holding
+        completion until minimal replication is met."""
         with self._lock:
             self._leases.check(path, client)
+            for bid in block_lengths:
+                bids = (self._groups[bid].bids if bid in self._groups
+                        else [bid])
+                for b in bids:
+                    info = self._blocks.get(b)
+                    if info is None or not (info.locations & set(self._datanodes)):
+                        _M.incr("complete_waiting_ibr")
+                        return False
             self._log(["complete", path, dict(block_lengths), time.time()])
             self._leases.release(path, client)
             _M.incr("complete")
@@ -594,6 +855,123 @@ class NameNode:
                     "mtime": node.mtime, "ec": node.ec}
         return {"name": name, "type": "dir", "children": len(node)}
 
+    # ----------------------------------------------------- snapshots & quotas
+
+    def rpc_allow_snapshot(self, path: str) -> bool:
+        with self._lock:
+            self._log(["allow_snapshot", path])
+            return True
+
+    def rpc_create_snapshot(self, path: str, name: str) -> bool:
+        with self._lock:
+            self._log(["create_snapshot", path, name])
+            _M.incr("snapshots_created")
+            return True
+
+    def rpc_delete_snapshot(self, path: str, name: str) -> bool:
+        with self._lock:
+            self._log(["delete_snapshot", path, name])
+            return True
+
+    def rpc_list_snapshots(self, path: str) -> list[str]:
+        with self._lock:
+            p = "/" + "/".join(self._parts(path))
+            if p not in self._snapshots:
+                raise FileNotFoundError(f"{p} is not snapshottable")
+            return sorted(self._snapshots[p])
+
+    def rpc_set_quota(self, path: str, namespace_quota: int = -1,
+                      space_quota: int = -1) -> bool:
+        """-1/-1 clears (setQuota/clrQuota analog)."""
+        with self._lock:
+            self._log(["set_quota", path, namespace_quota, space_quota])
+            return True
+
+    def rpc_content_summary(self, path: str) -> dict:
+        """du -s analog (getContentSummary)."""
+        with self._lock:
+            node = self._resolve(path)
+            files = dirs = length = 0
+            if isinstance(node, FileNode):
+                files, length = 1, self._file_len(node)
+            else:
+                dirs = 1
+                for fn in self._iter_files(node):
+                    files += 1
+                    length += self._file_len(fn)
+                dirs += sum(1 for _ in self._iter_dirs(node))
+            p = "/" + "/".join(self._parts(path)) if path.strip("/") else "/"
+            q = self._quotas.get(p, (-1, -1))
+            return {"files": files, "dirs": dirs, "length": length,
+                    "namespace_quota": q[0], "space_quota": q[1]}
+
+    def _file_len(self, fn: FileNode) -> int:
+        if fn.ec:
+            return sum(max(self._groups[g].logical_len, 0)
+                       for g in fn.blocks if g in self._groups)
+        return sum(max(self._blocks[b].length, 0)
+                   for b in fn.blocks if b in self._blocks)
+
+    @staticmethod
+    def _iter_dirs(node: Any):
+        if isinstance(node, dict):
+            for child in node.values():
+                if isinstance(child, dict):
+                    yield child
+                    yield from NameNode._iter_dirs(child)
+
+    def _quota_roots_of(self, path: str) -> list[tuple[str, tuple[int, int]]]:
+        parts = self._parts(path)
+        out = []
+        for i in range(len(parts)):
+            p = "/" + "/".join(parts[:i + 1])
+            if p in self._quotas:
+                out.append((p, self._quotas[p]))
+        return out
+
+    def _usage(self, root: str) -> list:
+        """[namespace entries incl. the root dir, completed logical bytes],
+        cached; recomputed only after structural mutations."""
+        u = self._qusage.get(root)
+        if u is None:
+            node = self._try_dir(root)
+            if node is None:
+                u = [0, 0]
+            else:
+                files = list(self._iter_files(node))
+                u = [1 + len(files) + sum(1 for _ in self._iter_dirs(node)),
+                     sum(self._file_len(fn) for fn in files)]
+            self._qusage[root] = u
+        return u
+
+    def _check_ns_quota(self, path: str) -> None:
+        """One new namespace entry at ``path``: every enclosing quota dir
+        must have headroom (QuotaExceededException analog; HDFS semantics —
+        the quota'd directory itself counts)."""
+        for p, (ns_q, _) in self._quota_roots_of(path):
+            if ns_q < 0:
+                continue
+            count = self._usage(p)[0]
+            if count + 1 > ns_q:
+                raise OSError(f"namespace quota of {p} exceeded: "
+                              f"{count}+1 > {ns_q}")
+
+    def _check_space_quota(self, path: str, additional: int) -> None:
+        for p, (_, sp_q) in self._quota_roots_of(path):
+            if sp_q < 0:
+                continue
+            used = self._usage(p)[1]
+            if used + additional > sp_q:
+                raise OSError(f"space quota of {p} exceeded: "
+                              f"{used}+{additional} > {sp_q}")
+
+    def _try_dir(self, path: str) -> Any | None:
+        try:
+            node = self._resolve(path)
+            return node if isinstance(node, dict) else None
+        except (FileNotFoundError, NotADirectoryError):
+            return None
+
     # --------------------------------------------------- datanode RPC: control
 
     def rpc_register_datanode(self, dn_id: str, addr: list,
@@ -612,8 +990,11 @@ class NameNode:
                 return {"reregister": True, "commands": []}
             dn.last_heartbeat = time.monotonic()
             dn.stats = stats or {}
+            if self.role != "active":  # standby never commands DNs
+                return {"reregister": False, "commands": [],
+                        "role": self.role}
             cmds, dn.commands = dn.commands, []
-            return {"reregister": False, "commands": cmds}
+            return {"reregister": False, "commands": cmds, "role": self.role}
 
     def rpc_block_report(self, dn_id: str, blocks: list) -> bool:
         """Full report: authoritative sync of this DN's replica set
@@ -627,12 +1008,17 @@ class NameNode:
                 reported.add(bid)
                 info = self._blocks.get(bid)
                 if info is None:
-                    # replica for a deleted file: tell DN to drop it
-                    dn.commands.append({"cmd": "invalidate", "block_ids": [bid]})
+                    # replica for a deleted file: tell DN to drop it (only
+                    # the active may command — a lagging standby would
+                    # invalidate replicas it just hasn't heard about yet)
+                    if self.role == "active":
+                        dn.commands.append({"cmd": "invalidate",
+                                            "block_ids": [bid]})
                     continue
                 info.locations.add(dn_id)
                 if info.length < 0:
                     info.length = length
+                    self._account_length(info.path, length)
             for bid in dn.blocks - reported:
                 info = self._blocks.get(bid)
                 if info:
@@ -646,13 +1032,46 @@ class NameNode:
         with self._lock:
             dn = self._datanodes.get(dn_id)
             info = self._blocks.get(block_id)
-            if dn is None or info is None:
+            if dn is None:
+                return False
+            if info is None:
+                if self.role == "standby":
+                    # IBR raced ahead of the journal tail: queue it (the
+                    # reference's PendingDataNodeMessages on the standby)
+                    self._pending_ibr.setdefault(block_id, []).append(
+                        (dn_id, length))
+                    if len(self._pending_ibr) > 100_000:
+                        self._pending_ibr.pop(next(iter(self._pending_ibr)))
                 return False
             dn.blocks.add(block_id)
             info.locations.add(dn_id)
             if info.length < 0:
                 info.length = length
+                self._account_length(info.path, length)
             return True
+
+    def _account_length(self, path: str, add: int) -> None:
+        """An in-flight block's length became known (IBR): cached space usage
+        of enclosing quota roots grows by it."""
+        if not self._quotas or add <= 0:
+            return
+        for r, _ in self._quota_roots_of(path):
+            u = self._qusage.get(r)
+            if u is not None:
+                u[1] += add
+
+    def _drain_pending_ibr(self) -> None:
+        """Apply queued IBRs whose blocks the journal tail has now created."""
+        for bid in [b for b in self._pending_ibr if b in self._blocks]:
+            for dn_id, length in self._pending_ibr.pop(bid):
+                info = self._blocks[bid]
+                dn = self._datanodes.get(dn_id)
+                if dn is not None:
+                    dn.blocks.add(bid)
+                    info.locations.add(dn_id)
+                    if info.length < 0:
+                        info.length = length
+                        self._account_length(info.path, length)
 
     # ------------------------------------------------------------- admin RPC
 
@@ -666,6 +1085,8 @@ class NameNode:
 
     def rpc_save_namespace(self) -> bool:
         with self._lock:
+            if self.role != "active":
+                raise StandbyError("namenode is standby")
             self._editlog.checkpoint()
             return True
 
@@ -707,6 +1128,8 @@ class NameNode:
         then invalidate on ``from_dn`` once the new location reports in
         (the Dispatcher/replaceBlock analog of the reference's Balancer)."""
         with self._lock:
+            if self.role != "active":
+                raise StandbyError("namenode is standby")
             info = self._blocks.get(block_id)
             src = self._datanodes.get(from_dn)
             dst = self._datanodes.get(to_dn)
@@ -754,11 +1177,53 @@ class NameNode:
         random.shuffle(live)
         return live[:n]
 
+    # -------------------------------------------------------------------- HA
+
+    def rpc_ha_state(self) -> dict:
+        return {"role": self.role, "seq": self._editlog.seq,
+                "epoch": self._editlog.read_epoch()}
+
+    def rpc_transition_to_active(self) -> bool:
+        """Manual/controller-driven failover (transitionToActive analog):
+        final catch-up tail, claim the journal epoch (fencing the old
+        active), open for append, start the redundancy monitor."""
+        with self._lock:
+            if self.role == "active":
+                return True
+            self._editlog.tail(self._apply_tolerant,
+                               reload_fn=self._reload_image)
+            self._drain_pending_ibr()
+            self._editlog.claim_epoch()
+            self._editlog.open_for_append(self._snapshot)
+            self.role = "active"
+        mon = threading.Thread(target=self._monitor_loop, name="nn-monitor",
+                               daemon=True)
+        mon.start()
+        _M.incr("transitions_to_active")
+        return True
+
+    def _tailer_loop(self) -> None:
+        """Standby: periodically replay the shared journal
+        (EditLogTailer.java:74 + StandbyCheckpointer roles)."""
+        interval = self.config.tail_interval_s
+        while not self._monitor_stop.wait(interval):
+            if self.role != "standby":
+                return  # transitioned; monitor thread has taken over
+            try:
+                with self._lock:
+                    self._editlog.tail(self._apply_tolerant,
+                                       reload_fn=self._reload_image)
+                    self._drain_pending_ibr()
+            except Exception:  # noqa: BLE001 — tailer must survive
+                _M.incr("tail_errors")
+
     def _monitor_loop(self) -> None:
         """HeartbeatManager.Monitor + RedundancyMonitor (§3.5): declare dead
         DNs, schedule re-replication, recover expired leases."""
         interval = self.config.heartbeat_interval_s
         while not self._monitor_stop.wait(interval):
+            if self.role != "active":
+                return  # demoted: the tailer owns this NN now
             try:
                 fault_injection.point("namenode.monitor_tick")
                 self._check_dead_nodes()

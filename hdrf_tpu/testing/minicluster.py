@@ -24,8 +24,9 @@ class MiniCluster:
     def __init__(self, n_datanodes: int = 3, base_dir: str | None = None,
                  replication: int = 3, block_size: int = 1 << 20,
                  container_size: int = 1 << 22, heartbeat_s: float = 0.2,
-                 dead_node_s: float = 1.5):
+                 dead_node_s: float = 1.5, ha: bool = False):
         self.n_datanodes = n_datanodes
+        self.ha = ha
         self._own_dir = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="hdrf-mini-")
         self.nn_config = NameNodeConfig(
@@ -35,16 +36,37 @@ class MiniCluster:
         self._dn_kw = dict(container_size=container_size)
         self._heartbeat_s = heartbeat_s
         self.namenode: NameNode | None = None
+        self.standby: NameNode | None = None  # MiniQJMHACluster analog
         self.datanodes: list[DataNode | None] = [None] * n_datanodes
 
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "MiniCluster":
         self.namenode = NameNode(self.nn_config).start()
+        if self.ha:
+            import dataclasses
+
+            sb_cfg = dataclasses.replace(self.nn_config, role="standby",
+                                         port=0)
+            self.standby = NameNode(sb_cfg).start()
         for i in range(self.n_datanodes):
             self.datanodes[i] = self._make_dn(i).start()
         self.wait_for_datanodes(self.n_datanodes)
         return self
+
+    def nn_addrs(self) -> list:
+        addrs = [self.namenode.addr]
+        if self.standby is not None:
+            addrs.append(self.standby.addr)
+        return addrs
+
+    def failover(self) -> NameNode:
+        """Kill the active NN and promote the standby (failover drill)."""
+        assert self.standby is not None, "not an HA cluster"
+        self.namenode.stop()
+        self.standby.rpc_transition_to_active()
+        self.namenode, self.standby = self.standby, None
+        return self.namenode
 
     def _make_dn(self, i: int) -> DataNode:
         cfg = DataNodeConfig(
@@ -53,12 +75,14 @@ class MiniCluster:
             block_report_interval_s=5.0)
         cfg.reduction.container_size = self._dn_kw["container_size"]
         cfg.reduction.backend = "native"  # deterministic in tests
-        return DataNode(cfg, self.namenode.addr, dn_id=f"dn-{i}")
+        return DataNode(cfg, self.nn_addrs(), dn_id=f"dn-{i}")
 
     def stop(self) -> None:
         for dn in self.datanodes:
             if dn is not None:
                 dn.stop()
+        if self.standby is not None:
+            self.standby.stop()
         if self.namenode is not None:
             self.namenode.stop()
         if self._own_dir:
@@ -108,7 +132,8 @@ class MiniCluster:
     # ------------------------------------------------------------- helpers
 
     def client(self, name: str | None = None) -> HdrfClient:
-        return HdrfClient(self.namenode.addr, name=name)
+        addrs = self.nn_addrs()
+        return HdrfClient(addrs if len(addrs) > 1 else addrs[0], name=name)
 
     def wait_for_datanodes(self, n: int, timeout: float = 10.0) -> None:
         deadline = time.monotonic() + timeout

@@ -7,8 +7,11 @@ dispatch; DFSAdmin, OfflineImageViewer / OfflineEditsViewer under
 
   namenode / datanode      daemon launchers
   httpfs                   WebHDFS-style HTTP gateway
-  dfs                      -ls -mkdir -put -get -cat -rm -mv -stat -du
+  dfs                      -ls -mkdir -put -get -cat -rm -mv -stat -du -count
+                           -createSnapshot -deleteSnapshot -lsSnapshots
   dfsadmin                 -report -savenamespace -metrics -movblock
+                           -allowSnapshot -setQuota -setSpaceQuota -clrQuota
+                           -haState -transitionToActive
   oiv / oev                offline fsimage / edit-log viewers
   balancer                 spread replicas toward the mean DN utilization
 """
@@ -118,6 +121,16 @@ def cmd_dfs(args) -> int:
             total = sum(e.get("length", 0) for e in c.ls(args.args[0])
                         if e["type"] == "file")
             print(total)
+        elif args.op == "-count":
+            s = c.content_summary(args.args[0])
+            print(f"{s['dirs']} {s['files']} {s['length']} {args.args[0]}")
+        elif args.op == "-createSnapshot":
+            c.create_snapshot(args.args[0], args.args[1])
+        elif args.op == "-deleteSnapshot":
+            c.delete_snapshot(args.args[0], args.args[1])
+        elif args.op == "-lsSnapshots":
+            for name in c.list_snapshots(args.args[0]):
+                print(name)
         else:
             print(f"unknown dfs op {args.op}", file=sys.stderr)
             return 1
@@ -140,6 +153,31 @@ def cmd_dfsadmin(args) -> int:
             print("namespace saved")
         elif args.op == "-metrics":
             print(json.dumps(c._nn.call("metrics"), indent=2, sort_keys=True))
+        elif args.op == "-allowSnapshot":
+            c.allow_snapshot(args.args[0])
+            print(f"snapshots enabled on {args.args[0]}")
+        elif args.op == "-setQuota":
+            c.set_quota(args.args[1], namespace_quota=int(args.args[0]))
+        elif args.op == "-setSpaceQuota":
+            c.set_quota(args.args[1], space_quota=int(args.args[0]))
+        elif args.op == "-clrQuota":
+            c.set_quota(args.args[0])
+        elif args.op == "-haState":
+            from hdrf_tpu.proto.rpc import RpcClient
+            for a in args.args or [args.namenode]:
+                host, port = a.rsplit(":", 1)
+                try:
+                    with RpcClient((host, int(port)), timeout=3.0) as rc:
+                        st = rc.call("ha_state")
+                    print(f"{a}: {st['role']} seq={st['seq']} epoch={st['epoch']}")
+                except (OSError, ConnectionError):
+                    print(f"{a}: unreachable")
+        elif args.op == "-transitionToActive":
+            from hdrf_tpu.proto.rpc import RpcClient
+            host, port = args.args[0].rsplit(":", 1)
+            with RpcClient((host, int(port))) as rc:
+                rc.call("transition_to_active")
+            print("transitioned")
         elif args.op == "-movblock":
             bid, src, dst = args.args
             ok = c._nn.call("move_block", block_id=int(bid), from_dn=src,
@@ -287,15 +325,21 @@ def main(argv: list[str] | None = None) -> int:
     d.set_defaults(fn=cmd_balancer)
 
     # dfs/dfsadmin ops are dash-prefixed like the reference shell (-ls,
-    # -put, ...), which argparse won't accept as positionals — collect them
-    # via parse_known_args instead.
-    args, extra = p.parse_known_args(argv)
+    # -put, ...), which argparse (and its subparsers) won't accept — split
+    # the command line at the first single-dash token and parse only the
+    # prefix; everything from the op onward passes through verbatim.
+    argv = list(sys.argv[1:] if argv is None else argv)
+    op_args: list[str] = []
+    if argv and argv[0] in ("dfs", "dfsadmin"):
+        for i, tok in enumerate(argv[1:], start=1):
+            if tok.startswith("-") and not tok.startswith("--"):
+                argv, op_args = argv[:i], argv[i:]
+                break
+    args = p.parse_args(argv)
     if getattr(args, "takes_ops", False):
-        if not extra:
+        if not op_args:
             p.error("missing operation")
-        args.op, args.args = extra[0], extra[1:]
-    elif extra:
-        p.error(f"unrecognized arguments: {' '.join(extra)}")
+        args.op, args.args = op_args[0], op_args[1:]
     return args.fn(args)
 
 

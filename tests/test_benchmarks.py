@@ -1,0 +1,38 @@
+"""The in-tree perf harnesses run end-to-end at tiny sizes (the reference
+keeps NNThroughputBenchmark etc. in the test tree; results are printed JSON,
+not asserted)."""
+
+import json
+import io
+from contextlib import redirect_stdout
+
+from hdrf_tpu import benchmarks
+
+
+def run(argv) -> list[dict]:
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert benchmarks.main(argv) == 0
+    return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+
+def test_nn_throughput():
+    out = run(["nn", "--ops", "50"])
+    assert {o["op"] for o in out} >= {"mkdir", "delete"}
+    assert all(o["ops_per_s"] > 0 for o in out)
+
+
+def test_dfs_throughput():
+    out = run(["dfs", "--mb", "2", "--datanodes", "2", "--replication", "1",
+               "--schemes", "direct,dedup_lz4"])
+    assert len(out) == 2 and all(o["write_MBps"] > 0 for o in out)
+
+
+def test_ec_throughput():
+    out = run(["ec", "--mb", "3", "--policy", "rs-3-2-4k"])
+    assert len(out) == 4
+
+
+def test_reduction_throughput():
+    out = run(["reduction", "--mb", "4", "--backend", "native"])
+    assert out[0]["chunks"] > 0

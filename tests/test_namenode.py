@@ -21,6 +21,14 @@ def register(nn, n=3):
         nn.rpc_register_datanode(f"dn-{i}", [f"h{i}", 1000 + i])
 
 
+def complete(nn, path, lengths, client="c1"):
+    """Report each block from dn-0 (the async-IBR contract: complete waits
+    for minimal replication), then complete."""
+    for bid, ln in lengths.items():
+        nn.rpc_block_received("dn-0", bid, ln)
+    assert nn.rpc_complete(path, client=client, block_lengths=lengths)
+
+
 class TestNamespace:
     def test_mkdir_listing_stat(self, nn):
         nn.rpc_mkdir("/a/b/c")
@@ -34,7 +42,7 @@ class TestNamespace:
         alloc = nn.rpc_add_block("/f", client="c1")
         assert len(alloc["targets"]) == 2  # replication
         assert alloc["scheme"] == "dedup_lz4"
-        nn.rpc_complete("/f", client="c1", block_lengths={alloc["block_id"]: 500})
+        complete(nn, "/f", {alloc["block_id"]: 500})
         st = nn.rpc_stat("/f")
         assert st["length"] == 500 and st["complete"]
 
@@ -63,7 +71,7 @@ class TestNamespace:
         register(nn)
         nn.rpc_create("/d/f", client="c1")
         a = nn.rpc_add_block("/d/f", client="c1")
-        nn.rpc_complete("/d/f", client="c1", block_lengths={a["block_id"]: 10})
+        complete(nn, "/d/f", {a["block_id"]: 10})
         nn.rpc_rename("/d/f", "/d2/g")
         assert nn.rpc_stat("/d2/g")["length"] == 10
         assert nn._blocks[a["block_id"]].path == "/d2/g"
@@ -97,7 +105,7 @@ class TestNamespace:
         nn.rpc_create("/f", client="c1")
         a = nn.rpc_add_block("/f", client="c1")
         bid = a["block_id"]
-        nn.rpc_complete("/f", client="c1", block_lengths={bid: 10})
+        complete(nn, "/f", {bid: 10})
         dn0 = a["targets"][0]["dn_id"]
         nn.rpc_block_received(dn0, bid, 10)
         nn.rpc_delete("/f")
@@ -111,7 +119,7 @@ class TestPersistence:
         nn.rpc_mkdir("/dir")
         nn.rpc_create("/dir/f", client="c1", scheme="lz4")
         a = nn.rpc_add_block("/dir/f", client="c1")
-        nn.rpc_complete("/dir/f", client="c1", block_lengths={a["block_id"]: 77})
+        complete(nn, "/dir/f", {a["block_id"]: 77})
         return a["block_id"]
 
     def test_wal_replay(self, nn, tmp_path):
@@ -130,7 +138,7 @@ class TestPersistence:
         register(nn)
         nn.rpc_create("/post", client="c1")
         a2 = nn.rpc_add_block("/post", client="c1")
-        nn.rpc_complete("/post", client="c1", block_lengths={a2["block_id"]: 5})
+        complete(nn, "/post", {a2["block_id"]: 5})
         nn._editlog.close()
         nn2 = NameNode(nn.config)
         assert nn2.rpc_stat("/dir/f")["length"] == 77
@@ -144,7 +152,7 @@ class TestBlockManagement:
         nn.rpc_create("/f", client="c1")
         a = nn.rpc_add_block("/f", client="c1")
         bid = a["block_id"]
-        nn.rpc_complete("/f", client="c1", block_lengths={bid: 9})
+        complete(nn, "/f", {bid: 9})
         nn.rpc_block_report("dn-0", [[bid, a["gen_stamp"], 9]])
         assert "dn-0" in nn._blocks[bid].locations
         # stale replica of a deleted file -> invalidate command
@@ -160,7 +168,7 @@ class TestBlockManagement:
         nn.rpc_create("/f", client="c1", replication=3)
         a = nn.rpc_add_block("/f", client="c1")
         bid = a["block_id"]
-        nn.rpc_complete("/f", client="c1", block_lengths={bid: 9})
+        complete(nn, "/f", {bid: 9})
         nn.rpc_block_received("dn-0", bid, 9)  # only 1 of 3 replicas
         nn._check_replication()
         cmds = nn.rpc_heartbeat("dn-0")["commands"]
@@ -174,7 +182,7 @@ class TestBlockManagement:
         nn.rpc_create("/f", client="c1")
         a = nn.rpc_add_block("/f", client="c1")
         bid = a["block_id"]
-        nn.rpc_complete("/f", client="c1", block_lengths={bid: 9})
+        complete(nn, "/f", {bid: 9})
         nn.rpc_block_received("dn-0", bid, 9)
         nn.config.dead_node_interval_s = -1  # everything is dead
         nn._check_dead_nodes()
@@ -221,7 +229,7 @@ class TestWalIntegrity:
         nn.rpc_create("/f", client="c1")
         alloc = nn.rpc_add_block("/f", client="c1")
         bid = alloc["block_id"]
-        nn.rpc_complete("/f", client="c1", block_lengths={bid: 10})
+        complete(nn, "/f", {bid: 10})
         # one replica reported on dn-0 only; replication=2 -> deficit 1
         nn.rpc_block_received("dn-0", bid, 10)
         nn._check_replication()

@@ -1,0 +1,90 @@
+"""Snapshots + quotas (namenode/snapshot 5.6 kLoC + quota subsystem analog):
+point-in-time reads through /.snapshot paths, block retention across deletes,
+namespace/space quota enforcement, content summary."""
+
+import numpy as np
+import pytest
+
+from hdrf_tpu.proto.rpc import RpcError
+from hdrf_tpu.testing.minicluster import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_datanodes=3, replication=2) as mc:
+        yield mc
+
+
+class TestSnapshots:
+    def test_snapshot_read_after_delete(self, cluster):
+        payload = np.random.default_rng(0).integers(
+            0, 256, size=150_000, dtype=np.uint8).tobytes()
+        with cluster.client("snap") as c:
+            c.write("/snapdir/f", payload, scheme="dedup_lz4")
+            c.allow_snapshot("/snapdir")
+            c.create_snapshot("/snapdir", "s1")
+            assert c.list_snapshots("/snapdir") == ["s1"]
+            c.delete("/snapdir/f")
+            assert not c.exists("/snapdir/f")
+            # the frozen view still reads the full content
+            assert c.read("/snapdir/.snapshot/s1/f") == payload
+            assert c.stat("/snapdir/.snapshot/s1/f")["length"] == len(payload)
+            # dropping the snapshot releases the blocks
+            c.delete_snapshot("/snapdir", "s1")
+            with pytest.raises(Exception):
+                c.read("/snapdir/.snapshot/s1/f")
+
+    def test_snapshot_isolated_from_new_writes(self, cluster):
+        with cluster.client("snap2") as c:
+            c.write("/sd2/a", b"v1" * 1000)
+            c.allow_snapshot("/sd2")
+            c.create_snapshot("/sd2", "before")
+            c.write("/sd2/b", b"v2" * 1000)
+            names = {e["name"] for e in c.ls("/sd2/.snapshot/before")}
+            assert names == {"a"}
+            assert {e["name"] for e in c.ls("/sd2")} == {"a", "b"}
+
+    def test_create_snapshot_requires_allow(self, cluster):
+        with cluster.client("snap3") as c:
+            c.mkdir("/sd3")
+            with pytest.raises(RpcError, match="not snapshottable"):
+                c.create_snapshot("/sd3", "x")
+
+    def test_snapshot_survives_nn_restart(self, cluster):
+        with cluster.client("snap4") as c:
+            c.write("/sd4/f", b"persist" * 500)
+            c.allow_snapshot("/sd4")
+            c.create_snapshot("/sd4", "keep")
+            c.delete("/sd4/f")
+        cluster.restart_namenode()
+        cluster.wait_for_datanodes(3)
+        with cluster.client("snap4b") as c:
+            assert c.read("/sd4/.snapshot/keep/f") == b"persist" * 500
+
+
+class TestQuotas:
+    def test_namespace_quota(self, cluster):
+        with cluster.client("q1") as c:
+            c.mkdir("/q1")
+            c.set_quota("/q1", namespace_quota=2)
+            c.write("/q1/a", b"x")
+            with pytest.raises(RpcError, match="namespace quota"):
+                c.write("/q1/b", b"y")
+            c.set_quota("/q1")  # clear
+            c.write("/q1/b", b"y")
+
+    def test_space_quota(self, cluster):
+        with cluster.client("q2") as c:
+            c.mkdir("/q2")
+            # block_size is 1 MiB in MiniCluster; one block fits, two don't
+            c.set_quota("/q2", space_quota=1 << 20)
+            with pytest.raises(RpcError, match="space quota"):
+                c.write("/q2/big", b"z" * (2 << 20))
+
+    def test_content_summary(self, cluster):
+        with cluster.client("q3") as c:
+            c.write("/cs/x/f1", b"a" * 1000)
+            c.write("/cs/f2", b"b" * 500)
+            s = c.content_summary("/cs")
+            assert s["files"] == 2 and s["length"] == 1500
+            assert s["dirs"] >= 2
