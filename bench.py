@@ -32,7 +32,7 @@ import time
 import numpy as np
 
 BLOCK_MB = 64
-N_BLOCKS = 4
+N_BLOCKS = 8
 CPU_MB = 32
 
 

@@ -42,13 +42,11 @@ class HdrfClient:
         StandbyError / connection failure)."""
         self.config = config or ClientConfig()
         self.name = name or f"client-{uuid.uuid4().hex[:8]}"
-        if isinstance(namenode_addr, (list,)) and namenode_addr \
-                and isinstance(namenode_addr[0], (list, tuple)):
-            from hdrf_tpu.proto.rpc import HaRpcClient
+        from hdrf_tpu.proto.rpc import HaRpcClient, normalize_addrs
 
-            self._nn = HaRpcClient([tuple(a) for a in namenode_addr])
-        else:
-            self._nn = RpcClient(tuple(namenode_addr))
+        addrs = normalize_addrs(namenode_addr)
+        self._nn = (HaRpcClient(addrs) if len(addrs) > 1
+                    else RpcClient(addrs[0]))
 
     def close(self) -> None:
         self._nn.close()

@@ -102,6 +102,8 @@ class RpcServer:
         self._server = Server((host, port), Handler)
         self._conns: set[socket.socket] = set()
         self._thread: threading.Thread | None = None
+        self._retry_cache: dict[str, tuple[float, list]] = {}
+        self._retry_lock = threading.Lock()
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -110,18 +112,50 @@ class RpcServer:
     def _dispatch(self, req: list) -> list:
         req_id, method, kwargs = req
         trace = kwargs.pop("_trace", None)
+        retry_id = kwargs.pop("_retry_id", None)
         fn = getattr(self._service, f"rpc_{method}", None)
         if fn is None:
             return [req_id, 1, {"error": "NoSuchMethod", "message": method}]
+        if retry_id is not None:
+            cached = self._retry_cache_get(retry_id)
+            if cached is not None:
+                self._metrics.incr("retry_cache_hits")
+                return [req_id, *cached]
         with self._tracer.span(method, parent=tuple(trace) if trace else None):
             try:
                 with self._metrics.time(f"{method}_us"):
                     result = fn(**kwargs)
                 self._metrics.incr(f"{method}_calls")
-                return [req_id, 0, result]
+                out = [0, result]
             except Exception as e:  # noqa: BLE001 — errors cross the wire
                 self._metrics.incr(f"{method}_errors")
-                return [req_id, 1, {"error": type(e).__name__, "message": str(e)}]
+                out = [1, {"error": type(e).__name__, "message": str(e)}]
+        if retry_id is not None:
+            self._retry_cache_put(retry_id, out)
+        return [req_id, *out]
+
+    # RetryCache analog: replayed responses for at-least-once HA retries.
+    _RETRY_TTL = 120.0
+
+    def _retry_cache_get(self, rid: str):
+        import time as _t
+
+        with self._retry_lock:
+            ent = self._retry_cache.get(rid)
+            if ent and ent[0] > _t.monotonic():
+                return ent[1]
+            return None
+
+    def _retry_cache_put(self, rid: str, out: list) -> None:
+        import time as _t
+
+        now = _t.monotonic()
+        with self._retry_lock:
+            self._retry_cache[rid] = (now + self._RETRY_TTL, out)
+            if len(self._retry_cache) > 50_000:  # expire the stale half
+                self._retry_cache = {k: v for k, v in
+                                     self._retry_cache.items()
+                                     if v[0] > now}
 
     def start(self) -> "RpcServer":
         self._thread = threading.Thread(
@@ -143,6 +177,14 @@ class RpcServer:
             s.close()
 
 
+def normalize_addrs(addr) -> list[tuple[str, int]]:
+    """One (host, port) pair or any sequence of pairs -> list of tuples."""
+    if (isinstance(addr, (list, tuple)) and addr
+            and isinstance(addr[0], (list, tuple))):
+        return [(a[0], int(a[1])) for a in addr]
+    return [(addr[0], int(addr[1]))]
+
+
 class HaRpcClient:
     """Failover proxy over an ordered NN list (the reference's
     ConfiguredFailoverProxyProvider + RetryProxy analog): on connection
@@ -152,10 +194,17 @@ class HaRpcClient:
     RETRIABLE = ("StandbyError",)
 
     def __init__(self, addrs: list[tuple[str, int]], timeout: float = 30.0):
-        self._clients = [RpcClient(a, timeout) for a in addrs]
+        self._clients = [RpcClient(a, timeout) for a in normalize_addrs(addrs)]
         self._cur = 0
 
     def call(self, method: str, **kwargs: Any) -> Any:
+        # One retry id per LOGICAL call: a mutation that succeeded just before
+        # the connection died must not re-execute when the proxy retries — the
+        # server's retry cache replays the original response instead (the
+        # NameNode RetryCache that HDFS pairs with its failover proxy).
+        import uuid as _uuid
+
+        kwargs["_retry_id"] = _uuid.uuid4().hex
         last: Exception | None = None
         for attempt in range(2 * len(self._clients)):
             c = self._clients[self._cur]

@@ -71,13 +71,8 @@ class DataNode:
         self._read_sem = threading.Semaphore(red.max_concurrent_reads)
         self._direct_sem = threading.Semaphore(red.max_concurrent_direct)
         self.dn_id = dn_id or f"dn-{uuid.uuid4().hex[:8]}"
-        if (isinstance(namenode_addr, list) and namenode_addr
-                and isinstance(namenode_addr[0], (list, tuple))):
-            addrs = [tuple(a) for a in namenode_addr]
-        else:
-            addrs = [tuple(namenode_addr)]
-        self._nns = [RpcClient(a) for a in addrs]
-        self._nn = self._nns[0]  # convenience for single-NN paths
+        from hdrf_tpu.proto.rpc import normalize_addrs
+        self._nns = [RpcClient(a) for a in normalize_addrs(namenode_addr)]
         self._receiver = BlockReceiver(self)
         self._sender = BlockSender(self)
         self._stop = threading.Event()

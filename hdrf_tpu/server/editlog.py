@@ -42,6 +42,9 @@ class EditLog:
         self._snapshot_fn: Callable[[], Any] | None = None
         self._wal = None  # opened after recovery
         self._epoch: int | None = None  # writer epoch once active
+        self._lock_f = None
+        self._epoch_cache: int | None = None
+        self._epoch_sig = ()
 
     # ----------------------------------------------------------- HA fencing
 
@@ -123,16 +126,46 @@ class EditLog:
     # --------------------------------------------------------------- logging
 
     def _fence_lock(self):
-        """An flock'd handle on the shared lock file.  Held across
+        """An flock'd context on the shared lock file (persistent handle: the
+        append hot path must not pay open/close per op).  Held across
         epoch-check + WAL write so a concurrent claim_epoch (which takes the
         same lock) cannot interleave — without it a fenced writer could slip
         one record into the journal between its check and its write, and its
         seq would collide with the new active's next acked edit."""
+        import contextlib
         import fcntl
 
-        f = open(os.path.join(self._dir, "journal.lock"), "a+")
-        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
-        return f
+        if self._lock_f is None or self._lock_f.closed:
+            self._lock_f = open(os.path.join(self._dir, "journal.lock"), "a+")
+
+        @contextlib.contextmanager
+        def held():
+            fcntl.flock(self._lock_f.fileno(), fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(self._lock_f.fileno(), fcntl.LOCK_UN)
+
+        return held()
+
+    def _check_fence(self) -> None:
+        """Raise FencedError iff another writer claimed a newer epoch.  The
+        epoch value is cached against the file's stat signature so the hot
+        path pays one stat, not an open+read."""
+        if self._epoch is None:
+            return
+        path = os.path.join(self._dir, EPOCH_NAME)
+        try:
+            st = os.stat(path)
+            sig = (st.st_mtime_ns, st.st_ino)
+        except FileNotFoundError:
+            sig = None
+        if sig != self._epoch_sig:
+            self._epoch_cache = self.read_epoch()
+            self._epoch_sig = sig
+        if self._epoch_cache != self._epoch:
+            raise FencedError(
+                f"epoch {self._epoch} superseded by {self._epoch_cache}")
 
     def append(self, rec: list) -> None:
         """Durably log one mutation (logSync analog — every record is fsync'd;
@@ -140,9 +173,7 @@ class EditLog:
         payload = msgpack.packb([self.seq + 1, *rec])
         fault_injection.point("editlog.append")
         with self._fence_lock():
-            if self._epoch is not None and self.read_epoch() != self._epoch:
-                raise FencedError(
-                    f"epoch {self._epoch} superseded by {self.read_epoch()}")
+            self._check_fence()
             self._wal.write(walmod.frame(payload))
             self._wal.flush()
             os.fsync(self._wal.fileno())
@@ -152,6 +183,10 @@ class EditLog:
             self.checkpoint()
 
     def checkpoint(self) -> None:
+        # Fenced like append: a split-brain old active must never overwrite
+        # the fsimage or truncate the shared WAL after a promotion.
+        with self._fence_lock():
+            self._check_fence()
         snapshot = self._snapshot_fn() if self._snapshot_fn else None
         tmp = os.path.join(self._dir, IMG_TMP)
         with open(tmp, "wb") as f:
@@ -169,3 +204,6 @@ class EditLog:
         if self._wal is not None:
             self._wal.close()
             self._wal = None
+        if self._lock_f is not None:
+            self._lock_f.close()
+            self._lock_f = None

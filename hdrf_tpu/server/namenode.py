@@ -142,6 +142,8 @@ class NameNode:
         self._pending_repl: dict[int, float] = {}  # block_id -> retry deadline
         self._pending_moves: dict[int, str] = {}   # balancer: block -> old DN
         self._pending_ibr: dict[int, list] = {}    # standby: IBRs ahead of tail
+        self._alloc_charge: dict[int, tuple[str, int]] = {}  # bid -> (path, bytes)
+        self._pending_space: dict[str, int] = {}   # quota root -> charged bytes
         # Snapshots: frozen subtree images per snapshottable dir
         # (namenode/snapshot analog; blocks are immutable once complete, so a
         # structural freeze IS a consistent point-in-time view).
@@ -298,12 +300,14 @@ class NameNode:
             if bid in node.blocks:
                 node.blocks.remove(bid)
             self._blocks.pop(bid, None)
+            self._uncharge_alloc(bid)
         elif op == "complete":
             _, path, lengths, mtime = rec
             node = self._file(path)
             node.complete = True
             node.mtime = mtime
             for bid, ln in lengths.items():
+                self._uncharge_alloc(bid)
                 if bid in self._groups:
                     self._groups[bid].logical_len = ln
                 elif bid in self._blocks:
@@ -326,11 +330,15 @@ class NameNode:
         elif op == "set_quota":
             _, path, ns_q, sp_q = rec
             path = "/" + "/".join(self._parts(path))
-            if ns_q < 0 and sp_q < 0:
+            if ns_q < 0 and sp_q < 0:  # clrQuota form
                 self._quotas.pop(path, None)
                 self._qusage.pop(path, None)
             else:
-                self._quotas[path] = (ns_q, sp_q)
+                # -1 on one axis keeps the existing limit: -setQuota and
+                # -setSpaceQuota must compose, as the HDFS commands do
+                old = self._quotas.get(path, (-1, -1))
+                self._quotas[path] = (ns_q if ns_q >= 0 else old[0],
+                                      sp_q if sp_q >= 0 else old[1])
                 self._qusage[path] = None  # seed lazily
 
     def _account(self, rec: list) -> None:
@@ -559,6 +567,7 @@ class NameNode:
         self._leases.drop_subtree(path)
 
     def _drop_block(self, bid: int) -> None:
+        self._uncharge_alloc(bid)
         info = self._blocks.pop(bid, None)
         if info:
             for dn_id in info.locations:
@@ -706,6 +715,7 @@ class NameNode:
             if not targets:
                 raise IOError("no datanodes available")
             self._log(["add_block", path, bid, gs])
+            self._charge_alloc(path, bid, self.config.block_size)
             _M.incr("add_block")
             return {"block_id": bid, "gen_stamp": gs, "scheme": node.scheme,
                     "targets": [{"dn_id": d.dn_id, "addr": list(d.addr)}
@@ -733,6 +743,7 @@ class NameNode:
             bids = list(range(self._next_block_id, self._next_block_id + k + m))
             gs = self._gen_stamp
             self._log(["add_block_group", path, bids, gs])
+            self._charge_alloc(path, bids[0], k * self.config.block_size)
             _M.incr("add_block_group")
             return {"group_id": bids[0], "gen_stamp": gs, "k": k, "m": m,
                     "cell": cell,
@@ -960,7 +971,7 @@ class NameNode:
         for p, (_, sp_q) in self._quota_roots_of(path):
             if sp_q < 0:
                 continue
-            used = self._usage(p)[1]
+            used = self._usage(p)[1] + self._pending_space.get(p, 0)
             if used + additional > sp_q:
                 raise OSError(f"space quota of {p} exceeded: "
                               f"{used}+{additional} > {sp_q}")
@@ -1059,6 +1070,28 @@ class NameNode:
             u = self._qusage.get(r)
             if u is not None:
                 u[1] += add
+
+    def _charge_alloc(self, path: str, bid: int, size: int) -> None:
+        """Conservative full-block space charge at allocation time (HDFS does
+        the same): async IBRs would otherwise let back-to-back add_block
+        calls race past the quota."""
+        if not self._quotas:
+            return
+        self._alloc_charge[bid] = (path, size)
+        for r, _ in self._quota_roots_of(path):
+            self._pending_space[r] = self._pending_space.get(r, 0) + size
+
+    def _uncharge_alloc(self, bid: int) -> None:
+        ch = self._alloc_charge.pop(bid, None)
+        if ch is None:
+            return
+        path, size = ch
+        for r, _ in self._quota_roots_of(path):
+            left = self._pending_space.get(r, 0) - size
+            if left > 0:
+                self._pending_space[r] = left
+            else:
+                self._pending_space.pop(r, None)
 
     def _drain_pending_ibr(self) -> None:
         """Apply queued IBRs whose blocks the journal tail has now created."""
@@ -1190,10 +1223,13 @@ class NameNode:
         with self._lock:
             if self.role == "active":
                 return True
+            # claim FIRST (fencing the old writer), THEN the final tail —
+            # the reverse order loses any edit the not-yet-fenced active
+            # appends between the tail and the claim, and reuses its seq.
+            self._editlog.claim_epoch()
             self._editlog.tail(self._apply_tolerant,
                                reload_fn=self._reload_image)
             self._drain_pending_ibr()
-            self._editlog.claim_epoch()
             self._editlog.open_for_append(self._snapshot)
             self.role = "active"
         mon = threading.Thread(target=self._monitor_loop, name="nn-monitor",
