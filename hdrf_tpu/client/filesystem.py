@@ -107,6 +107,31 @@ class HdrfClient:
     def content_summary(self, path: str) -> dict:
         return self._nn.call("content_summary", path=path)
 
+    def events(self, since_seq: int = 0, poll_s: float = 0.2):
+        """Namespace event iterator (DFSInotifyEventInputStream analog):
+        yields event dicts forever; break when done.  Raises IOError when the
+        server's ring trimmed events past this consumer (the
+        MissingEventsException analog) — resync via a listing and a fresh
+        iterator."""
+        import time as _t
+
+        seq = since_seq
+        while True:
+            resp = self._nn.call("get_events", since_seq=seq)
+            if seq and resp["trimmed_through"] > seq:
+                raise IOError(
+                    f"event stream gap: events through "
+                    f"{resp['trimmed_through']} were trimmed, consumer at "
+                    f"{seq}")
+            for ev in resp["events"]:
+                yield ev
+                seq = ev["seq"]
+            if not resp["events"]:
+                # no events in (seq, last_seq]: those edits emit no events,
+                # so skipping ahead is safe and keeps the next poll cheap
+                seq = max(seq, resp["last_seq"])
+                _t.sleep(poll_s)
+
     # ----------------------------------------------------------------- write
 
     def write(self, path: str, data: bytes, scheme: str | None = None,
@@ -128,11 +153,19 @@ class HdrfClient:
             block_size = info["block_size"]
             lengths: dict[int, int] = {}
             off = 0
+            import time as _t
+
+            last_renew = _t.monotonic()
             while True:
                 block = data[off:off + block_size]
                 bid = self._write_block(path, block)
                 lengths[bid] = len(block)
                 off += block_size
+                # LeaseRenewer analog: time-based, at 1/3 of the 60 s lease
+                # expiry — a slow write must not outlive its lease
+                if _t.monotonic() - last_renew > 20.0:
+                    self._nn.call("renew_lease", client=self.name)
+                    last_renew = _t.monotonic()
                 if off >= len(data):
                     break
             self._complete(path, lengths)

@@ -105,6 +105,11 @@ class DataNodeConfig:
     # Rolling replica verification cadence (BlockScanner analog); one block
     # verified per tick, 0 disables.
     scan_interval_s: float = 30.0
+    # Volume health probe cadence (DatasetVolumeChecker analog); 0 disables.
+    volume_check_interval_s: float = 15.0
+    # RAM-backed fake dataset for protocol tests at scale
+    # (SimulatedFSDataset analog).
+    simulated_dataset: bool = False
     reduction: ReductionConfig = field(default_factory=ReductionConfig)
 
 

@@ -58,7 +58,13 @@ class DataNode:
         self.checksum_chunk = 64 * 1024
         red = config.reduction
         os.makedirs(config.data_dir, exist_ok=True)
-        self.replicas = ReplicaStore(os.path.join(config.data_dir, "replicas"))
+        if config.simulated_dataset:
+            from hdrf_tpu.storage.simulated import SimulatedReplicaStore
+
+            self.replicas = SimulatedReplicaStore()
+        else:
+            self.replicas = ReplicaStore(
+                os.path.join(config.data_dir, "replicas"))
         self.containers = ContainerStore(
             os.path.join(config.data_dir, "containers"),
             container_size=red.container_size, codec=red.container_codec)
@@ -126,6 +132,12 @@ class DataNode:
                                   name=f"{self.dn_id}-scanner", daemon=True)
             sc.start()
             self._threads.append(sc)
+        if self.config.volume_check_interval_s > 0 \
+                and not self.config.simulated_dataset:
+            vc = threading.Thread(target=self._volume_check_loop,
+                                  name=f"{self.dn_id}-volcheck", daemon=True)
+            vc.start()
+            self._threads.append(vc)
         return self
 
     def stop(self) -> None:
@@ -386,6 +398,42 @@ class DataNode:
     def run_directory_scan(self) -> list[str]:
         """DirectoryScanner trigger (tests + admin)."""
         return self.replicas.scan()
+
+    # ---------------------------------------------------------- volume health
+
+    def check_volume(self) -> bool:
+        """One write+read+unlink probe of the data dir (DatasetVolumeChecker's
+        disk check).  True = healthy."""
+        probe = os.path.join(self.config.data_dir, ".probe")
+        try:
+            with open(probe, "wb") as f:
+                f.write(b"hdrf-volume-probe")
+                f.flush()
+                os.fsync(f.fileno())
+            with open(probe, "rb") as f:
+                ok = f.read() == b"hdrf-volume-probe"
+            os.unlink(probe)
+            return ok
+        except OSError:
+            return False
+
+    def _volume_check_loop(self) -> None:
+        """Async disk health (DatasetVolumeChecker + ThrottledAsyncChecker
+        analog).  This DN has one volume, so the reference's eject-bad-volume
+        action becomes shut-down-the-DN (HDFS DNs exit when every volume has
+        failed); the NN's dead-node path re-replicates from peers."""
+        failures = 0
+        while not self._stop.wait(self.config.volume_check_interval_s):
+            if self.check_volume():
+                failures = 0
+                _M.incr("volume_checks_ok")
+                continue
+            failures += 1
+            _M.incr("volume_checks_failed")
+            if failures >= 3:
+                _M.incr("volume_failures_fatal")
+                threading.Thread(target=self.stop, daemon=True).start()
+                return
 
     # ----------------------------------------------------------- block scanner
 

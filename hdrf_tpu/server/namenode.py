@@ -143,6 +143,9 @@ class NameNode:
         self._pending_moves: dict[int, str] = {}   # balancer: block -> old DN
         self._pending_ibr: dict[int, list] = {}    # standby: IBRs ahead of tail
         self._alloc_charge: dict[int, tuple[str, int]] = {}  # bid -> (path, bytes)
+        self._events: list[dict] = []   # inotify ring (active only)
+        self._events_cap = 10_000
+        self._events_trimmed = 0        # events up to this seq were dropped
         self._pending_space: dict[str, int] = {}   # quota root -> charged bytes
         # Snapshots: frozen subtree images per snapshottable dir
         # (namenode/snapshot analog; blocks are immutable once complete, so a
@@ -403,9 +406,11 @@ class NameNode:
                 delta += ln - max(prev, 0)
             self._apply(rec)
             self._account(rec + [delta])
+            self._emit_event(rec)
         else:
             self._apply(rec)
             self._account(rec)
+            self._emit_event(rec)
 
     def _demote(self) -> None:
         self.role = "standby"
@@ -1211,6 +1216,36 @@ class NameNode:
         return live[:n]
 
     # -------------------------------------------------------------------- HA
+
+    # --------------------------------------------------------------- inotify
+
+    def rpc_get_events(self, since_seq: int = 0, limit: int = 1000) -> dict:
+        """Edit-event stream (hdfs/inotify analog — DFSInotifyEventInputStream
+        over getEditsFromTxid): events after ``since_seq`` from the in-memory
+        ring.  ``first_seq`` lets a slow consumer detect gaps (ring
+        overwrote) and resync via a namespace listing."""
+        with self._lock:
+            evs = [e for e in self._events if e["seq"] > since_seq][:limit]
+            return {"events": evs, "last_seq": self._editlog.seq,
+                    "trimmed_through": self._events_trimmed}
+
+    _EVENT_TYPES = {"create": "create", "complete": "close",
+                    "delete": "unlink", "rename": "rename",
+                    "mkdir": "mkdir"}
+
+    def _emit_event(self, rec: list) -> None:
+        kind = self._EVENT_TYPES.get(rec[0])
+        if kind is None:
+            return
+        ev = {"seq": self._editlog.seq, "type": kind, "path": rec[1],
+              "time": time.time()}
+        if kind == "rename":
+            ev["dst"] = rec[2]
+        self._events.append(ev)
+        if len(self._events) > self._events_cap:
+            drop = self._events_cap // 10
+            self._events_trimmed = self._events[drop - 1]["seq"]
+            del self._events[:drop]
 
     def rpc_ha_state(self) -> dict:
         return {"role": self.role, "seq": self._editlog.seq,

@@ -125,3 +125,77 @@ class TestHttpGateway:
                 assert e.code == 404
         finally:
             gw.stop()
+
+
+class TestVolumeChecker:
+    def test_probe_and_fatal_shutdown(self, tmp_path):
+        from hdrf_tpu.config import DataNodeConfig
+        from hdrf_tpu.server.datanode import DataNode
+        from hdrf_tpu.server.namenode import NameNode
+        from hdrf_tpu.config import NameNodeConfig
+
+        nn = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "nn"))).start()
+        try:
+            cfg = DataNodeConfig(data_dir=str(tmp_path / "dn"),
+                                 volume_check_interval_s=0)  # manual probes
+            dn = DataNode(cfg, nn.addr, dn_id="dn-vol").start()
+            try:
+                assert dn.check_volume() is True
+                # simulate volume death: the dir vanishes out from under the
+                # DN (root ignores permission bits, so chmod won't do)
+                dn.config.data_dir = str(tmp_path / "gone")
+                assert dn.check_volume() is False
+            finally:
+                dn.stop()
+        finally:
+            nn.stop()
+
+
+class TestSimulatedDataset:
+    def test_protocol_flow_without_disk(self, tmp_path):
+        from hdrf_tpu.config import DataNodeConfig, NameNodeConfig
+        from hdrf_tpu.server.datanode import DataNode
+        from hdrf_tpu.server.namenode import NameNode
+        from hdrf_tpu.client.filesystem import HdrfClient
+        from hdrf_tpu.config import ClientConfig
+
+        nn = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "nn"),
+                                     replication=1,
+                                     block_size=256 * 1024)).start()
+        try:
+            cfg = DataNodeConfig(data_dir=str(tmp_path / "dn"),
+                                 simulated_dataset=True)
+            dn = DataNode(cfg, nn.addr, dn_id="dn-sim").start()
+            try:
+                payload = b"simulated!" * 30_000
+                # short-circuit is unavailable on the RAM dataset
+                ccfg = ClientConfig(short_circuit=False)
+                with HdrfClient(nn.addr, config=ccfg, name="sim") as c:
+                    c.write("/sim/f", payload, scheme="direct")
+                    assert c.read("/sim/f") == payload
+                assert dn.replicas.physical_bytes() == len(payload)
+                import os
+                assert not os.listdir(os.path.join(cfg.data_dir)) or \
+                    "replicas" not in os.listdir(cfg.data_dir)
+            finally:
+                dn.stop()
+        finally:
+            nn.stop()
+
+
+class TestInotify:
+    def test_event_stream(self, cluster):
+        with cluster.client("ev") as c:
+            start = c._nn.call("get_events")["last_seq"]
+            c.mkdir("/ev/d")
+            c.write("/ev/f", b"x" * 1000)
+            c.rename("/ev/f", "/ev/g")
+            c.delete("/ev/g")
+            resp = c._nn.call("get_events", since_seq=start)
+            kinds = [(e["type"], e["path"]) for e in resp["events"]]
+            assert ("mkdir", "/ev/d") in kinds
+            assert ("create", "/ev/f") in kinds
+            assert ("close", "/ev/f") in kinds
+            assert ("unlink", "/ev/g") in kinds
+            rn = [e for e in resp["events"] if e["type"] == "rename"]
+            assert rn and rn[0]["dst"] == "/ev/g"
