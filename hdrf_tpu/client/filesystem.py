@@ -209,7 +209,7 @@ class HdrfClient:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             dt.send_op(sock, dt.WRITE_BLOCK, block_id=alloc["block_id"],
                        gen_stamp=alloc["gen_stamp"], scheme=alloc["scheme"],
-                       targets=targets[1:])
+                       token=alloc.get("token"), targets=targets[1:])
             npkts = dt.stream_bytes(sock, block, self.config.packet_size)
             # Drain per-packet acks; the final one carries pipeline status.
             status = dt.ACK_SUCCESS
@@ -273,7 +273,8 @@ class HdrfClient:
         for loc in locations:  # failover across replicas
             try:
                 return self._read_from(tuple(loc["addr"]), binfo["block_id"],
-                                       offset, length)
+                                       offset, length,
+                                       token=binfo.get("token"))
             except (OSError, ConnectionError, IOError) as e:
                 last_err = e
                 _M.incr("read_failovers")
@@ -281,12 +282,12 @@ class HdrfClient:
                       f"{binfo['block_id']}: {last_err}")
 
     def _read_from(self, addr: tuple[str, int], block_id: int, offset: int,
-                   length: int) -> bytes:
+                   length: int, token: dict | None = None) -> bytes:
         sock = socket.create_connection(addr, timeout=120)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             dt.send_op(sock, dt.READ_BLOCK, block_id=block_id, offset=offset,
-                       length=length)
+                       length=length, token=token)
             hdr = recv_frame(sock)
             if hdr["status"] != 0:
                 raise IOError(f"datanode error: {hdr['error']}: {hdr['message']}")

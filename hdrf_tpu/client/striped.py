@@ -100,7 +100,8 @@ class StripedWriter:
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             dt.send_op(sock, dt.WRITE_BLOCK, block_id=blk["block_id"],
-                       gen_stamp=gen_stamp, scheme="direct", targets=[])
+                       gen_stamp=gen_stamp, scheme="direct",
+                       token=blk.get("token"), targets=[])
             n = dt.stream_bytes(sock, shard, c.config.packet_size)
             status = dt.ACK_SUCCESS
             for _ in range(n):
@@ -175,7 +176,8 @@ class StripedReader:
         for locd in blk["locations"]:
             try:
                 return dt.fetch_block(tuple(locd["addr"]), blk["block_id"],
-                                      offset, length)
+                                      offset, length,
+                                      token=blk.get("token"))
             except (OSError, ConnectionError, IOError):
                 _M.incr("ec_shard_read_failures")
         return None

@@ -91,6 +91,9 @@ class NameNodeConfig:
     role: str = "active"
     # Standby journal catch-up cadence (EditLogTailer interval analog).
     tail_interval_s: float = 0.5
+    # Block access tokens (dfs.block.access.token.enable analog): NN mints
+    # HMAC tokens, DNs verify; keys ride heartbeat responses.
+    block_tokens: bool = False
 
 
 @dataclass

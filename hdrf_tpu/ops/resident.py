@@ -142,9 +142,12 @@ class ResidentReducer:
         # bucket (max_chunk rounded up) + the funnel-shift lookahead word.
         max_nb = (self.cdc.max_chunk + 9 + 63) // 64
         self.pad_words = _bucket_of(max_nb) * 16 + 16
-        # Two-bucket SHA dispatch plan: small bucket = 2x the average chunk.
-        self._b_small = _bucket_of(((2 << self.cdc.mask_bits) + 72) // 64)
-        self._b_big = _bucket_of(max_nb)
+        # Two-bucket SHA dispatch plan: small bucket = exactly 2x the average
+        # chunk, big bucket = exactly max_chunk.  Bucket widths are jit-cache
+        # keys, not layout constraints — pow2 rounding here would double the
+        # padded SHA work for the mass of the distribution.
+        self._b_small = (2 << self.cdc.mask_bits) // 64
+        self._b_big = max_nb
 
     def submit(self, data: bytes | np.ndarray | jax.Array,
                n: int | None = None) -> BlockJob:

@@ -112,7 +112,8 @@ def stream_bytes(sock: socket.socket, data: bytes,
 
 
 def fetch_block(addr: tuple, block_id: int, offset: int = 0,
-                length: int = -1, timeout: float = 60) -> bytes:
+                length: int = -1, timeout: float = 60,
+                token: dict | None = None) -> bytes:
     """One-shot READ_BLOCK: connect, request [offset, offset+length), collect
     the packet run, length-check.  Shared by the EC degraded-read path
     (client/striped.py) and DN reconstruction fan-in (server/datanode.py)."""
@@ -122,7 +123,7 @@ def fetch_block(addr: tuple, block_id: int, offset: int = 0,
     try:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         send_op(sock, READ_BLOCK, block_id=block_id, offset=offset,
-                length=length)
+                length=length, token=token)
         hdr = recv_frame(sock)
         if hdr["status"] != 0:
             raise IOError(f"datanode error: {hdr['error']}: "

@@ -138,6 +138,12 @@ register(DirectScheme())
 register(CompressScheme("lz4"))
 register(CompressScheme("gzip"))
 register(CompressScheme("zstd"))
+# The reference's mode 0 (Snappy): python-snappy is an optional dependency;
+# register only when importable (environment gating, not a hard requirement).
+from hdrf_tpu.utils import codec as _codec  # noqa: E402
+
+if _codec.available("snappy"):
+    register(CompressScheme("snappy"))
 
 # Dedup schemes register themselves on import (hdrf_tpu/reduction/dedup.py).
 from hdrf_tpu.reduction import dedup as _dedup  # noqa: E402,F401

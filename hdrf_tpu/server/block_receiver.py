@@ -189,6 +189,7 @@ class BlockReceiver:
                            gen_stamp=gen_stamp, scheme=scheme_name,
                            logical_len=logical_len, checksums=crcs,
                            checksum_chunk=dn.checksum_chunk,
+                           token=dn.tokens.mint(block_id, "w"),
                            hashes=entry.hashes, targets=targets[1:])
                 need = recv_frame(mirror)["need"]  # indices into unique hash list
                 uniq = list(dict.fromkeys(entry.hashes))
@@ -209,6 +210,7 @@ class BlockReceiver:
                            gen_stamp=gen_stamp, scheme=scheme_name,
                            logical_len=logical_len, checksums=crcs,
                            checksum_chunk=dn.checksum_chunk,
+                           token=dn.tokens.mint(block_id, "w"),
                            hashes=None, targets=targets[1:])
                 recv_frame(mirror)  # symmetric need-frame (always empty here)
                 dt.stream_bytes(mirror, stored, dn.config.packet_size)

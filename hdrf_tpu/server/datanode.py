@@ -79,6 +79,8 @@ class DataNode:
         self.dn_id = dn_id or f"dn-{uuid.uuid4().hex[:8]}"
         from hdrf_tpu.proto.rpc import normalize_addrs
         self._nns = [RpcClient(a) for a in normalize_addrs(namenode_addr)]
+        from hdrf_tpu.security import BlockTokenVerifier
+        self.tokens = BlockTokenVerifier()
         self._receiver = BlockReceiver(self)
         self._sender = BlockSender(self)
         self._stop = threading.Event()
@@ -225,18 +227,23 @@ class DataNode:
         fault_injection.point("datanode.op", op=op)
         try:
             if op == dt.WRITE_BLOCK:
+                self.tokens.verify(fields.get("token"), fields["block_id"], "w")
                 if fields["scheme"] == "direct":
                     self._receiver.receive_direct(sock, fields)
                 else:
                     self._receiver.receive_reduced(sock, fields)
             elif op == "write_reduced":
+                self.tokens.verify(fields.get("token"), fields["block_id"], "w")
                 self._receiver.ingest_reduced(sock, fields)
             elif op == dt.READ_BLOCK:
+                self.tokens.verify(fields.get("token"), fields["block_id"], "r")
                 self._sender.serve_read(sock, fields)
             elif op == dt.BLOCK_CHECKSUM:
                 self._serve_checksum(sock, fields)
             else:
                 _M.incr("unknown_ops")
+        except PermissionError:
+            _M.incr("op_auth_failures")
         except (ConnectionError, OSError):
             _M.incr("op_io_errors")
         except Exception:  # noqa: BLE001 — xceiver thread must not die silently
@@ -294,6 +301,8 @@ class DataNode:
             for nn in self._nns:
                 try:
                     resp = nn.call("heartbeat", dn_id=self.dn_id, stats=stats)
+                    if resp.get("block_keys"):
+                        self.tokens.update_keys(resp["block_keys"])
                     if resp.get("reregister"):
                         self._register(nn)
                         continue
@@ -369,8 +378,9 @@ class DataNode:
                 break
             for loc in surv["locations"]:
                 try:
-                    data = dt.fetch_block(tuple(loc["addr"]),
-                                          surv["block_id"])
+                    data = dt.fetch_block(
+                        tuple(loc["addr"]), surv["block_id"],
+                        token=self.tokens.mint(surv["block_id"], "r"))
                     shards[surv["index"]] = np.frombuffer(data, dtype=np.uint8)
                     break
                 except (OSError, ConnectionError, IOError):

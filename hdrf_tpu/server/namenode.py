@@ -159,6 +159,9 @@ class NameNode:
         self._qusage: dict[str, list | None] = {}
         self._next_block_id = 1
         self._gen_stamp = 1
+        from hdrf_tpu.security import BlockTokenSecretManager
+        self._tokens = (BlockTokenSecretManager()
+                        if self.config.block_tokens else None)
         self._editlog = EditLog(self.config.meta_dir,
                                 self.config.editlog_checkpoint_every)
         self._load()
@@ -723,6 +726,8 @@ class NameNode:
             self._charge_alloc(path, bid, self.config.block_size)
             _M.incr("add_block")
             return {"block_id": bid, "gen_stamp": gs, "scheme": node.scheme,
+                    "token": (self._tokens.mint(bid, "w")
+                              if self._tokens else None),
                     "targets": [{"dn_id": d.dn_id, "addr": list(d.addr)}
                                 for d in targets]}
 
@@ -753,6 +758,8 @@ class NameNode:
             return {"group_id": bids[0], "gen_stamp": gs, "k": k, "m": m,
                     "cell": cell,
                     "blocks": [{"block_id": b,
+                                "token": (self._tokens.mint(b, "w")
+                                          if self._tokens else None),
                                 "target": {"dn_id": t.dn_id,
                                            "addr": list(t.addr)}}
                                for b, t in zip(bids, targets)]}
@@ -802,6 +809,8 @@ class NameNode:
                         "gen_stamp": self._blocks[gid].gen_stamp,
                         "length": grp.logical_len,
                         "blocks": [{"block_id": b,
+                                    "token": (self._tokens.mint(b, "r")
+                                              if self._tokens else None),
                                     "locations": self._locs_of(b)}
                                    for b in grp.bids]})
                 return {"ec": node.ec, "groups": groups, "scheme": node.scheme,
@@ -812,6 +821,8 @@ class NameNode:
                 info = self._blocks[bid]
                 blocks.append({"block_id": bid, "gen_stamp": info.gen_stamp,
                                "length": info.length,
+                               "token": (self._tokens.mint(bid, "r")
+                                         if self._tokens else None),
                                "locations": self._locs_of(bid)})
             return {"blocks": blocks, "scheme": node.scheme, "ec": None,
                     "length": sum(max(b["length"], 0) for b in blocks),
@@ -1006,11 +1017,16 @@ class NameNode:
                 return {"reregister": True, "commands": []}
             dn.last_heartbeat = time.monotonic()
             dn.stats = stats or {}
+            keys = None
+            if self._tokens is not None:
+                self._tokens.maybe_roll()
+                keys = self._tokens.keys()
             if self.role != "active":  # standby never commands DNs
                 return {"reregister": False, "commands": [],
-                        "role": self.role}
+                        "role": self.role, "block_keys": keys}
             cmds, dn.commands = dn.commands, []
-            return {"reregister": False, "commands": cmds, "role": self.role}
+            return {"reregister": False, "commands": cmds,
+                    "role": self.role, "block_keys": keys}
 
     def rpc_block_report(self, dn_id: str, blocks: list) -> bool:
         """Full report: authoritative sync of this DN's replica set

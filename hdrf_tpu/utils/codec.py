@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import zlib
 
-CODEC_IDS = {"none": 0, "lz4": 1, "zstd": 2, "gzip": 3}
+CODEC_IDS = {"none": 0, "lz4": 1, "zstd": 2, "gzip": 3, "snappy": 4}
 CODEC_NAMES = {v: k for k, v in CODEC_IDS.items()}
 
 
@@ -24,6 +24,10 @@ def compress(codec: str, data: bytes) -> bytes:
         return zstandard.ZstdCompressor(level=1).compress(data)
     if codec == "gzip":
         return zlib.compress(data, 1)
+    if codec == "snappy":
+        import snappy  # optional dep (the reference's mode 0; absent -> gated)
+
+        return snappy.compress(data)
     if codec == "none":
         return data
     raise KeyError(f"unknown codec {codec!r}")
@@ -40,6 +44,18 @@ def decompress(codec: str, data: bytes, usize: int) -> bytes:
         return zstandard.ZstdDecompressor().decompress(data, max_output_size=usize)
     if codec == "gzip":
         return zlib.decompress(data)
+    if codec == "snappy":
+        import snappy
+
+        return snappy.decompress(data)
     if codec == "none":
         return data
     raise KeyError(f"unknown codec {codec!r}")
+
+
+def available(codec: str) -> bool:
+    try:
+        compress(codec, b"x")
+        return True
+    except (ImportError, KeyError):
+        return False

@@ -199,3 +199,68 @@ class TestInotify:
             assert ("unlink", "/ev/g") in kinds
             rn = [e for e in resp["events"] if e["type"] == "rename"]
             assert rn and rn[0]["dst"] == "/ev/g"
+
+
+class TestBlockTokens:
+    def test_tokens_enforced_end_to_end(self, tmp_path):
+        import socket as _socket
+
+        from hdrf_tpu.testing.minicluster import MiniCluster
+        from hdrf_tpu.proto import datatransfer as dt
+        from hdrf_tpu.proto.rpc import recv_frame
+
+        with MiniCluster(n_datanodes=3, replication=2) as mc:
+            mc.nn_config.block_tokens = True  # too late for this NN; restart
+            mc.restart_namenode()
+            mc.wait_for_datanodes(3)
+            time.sleep(0.5)  # let heartbeats deliver the block keys
+            payload = b"secret" * 30_000
+            with mc.client("tok") as c:
+                c.write("/sec/f", payload)
+                assert c.read("/sec/f") == payload  # tokens flow end-to-end
+                loc = c._nn.call("get_block_locations", path="/sec/f")
+                binfo = loc["blocks"][0]
+                addr = tuple(binfo["locations"][0]["addr"])
+                # no token -> rejected
+                s = _socket.create_connection(addr, timeout=10)
+                try:
+                    dt.send_op(s, dt.READ_BLOCK, block_id=binfo["block_id"],
+                               offset=0, length=-1)
+                    try:
+                        hdr = recv_frame(s)
+                        raise AssertionError(f"served without token: {hdr}")
+                    except (ConnectionError, OSError):
+                        pass  # DN dropped the unauthorized connection
+                finally:
+                    s.close()
+                # tampered token -> rejected
+                bad = dict(binfo["token"])
+                bad["modes"] = "rw"
+                s = _socket.create_connection(addr, timeout=10)
+                try:
+                    dt.send_op(s, dt.READ_BLOCK, block_id=binfo["block_id"],
+                               offset=0, length=-1, token=bad)
+                    try:
+                        recv_frame(s)
+                        raise AssertionError("served with tampered token")
+                    except (ConnectionError, OSError):
+                        pass
+                finally:
+                    s.close()
+
+    def test_ec_with_tokens(self, tmp_path):
+        import numpy as np
+
+        from hdrf_tpu.testing.minicluster import MiniCluster
+
+        with MiniCluster(n_datanodes=5, block_size=64 * 1024) as mc:
+            mc.nn_config.block_tokens = True
+            mc.restart_namenode()
+            mc.wait_for_datanodes(5)
+            time.sleep(0.5)
+            data = np.random.default_rng(9).integers(
+                0, 256, 120_000, dtype=np.uint8).tobytes()
+            with mc.client("ectok") as c:
+                c.write("/sec/ec", data, ec="rs-3-2-4k")
+                mc.stop_datanode(0)
+                assert c.read("/sec/ec") == data  # degraded read with tokens
