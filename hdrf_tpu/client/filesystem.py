@@ -62,8 +62,59 @@ class HdrfClient:
     def mkdir(self, path: str) -> bool:
         return self._nn.call("mkdir", path=path)
 
-    def delete(self, path: str) -> bool:
-        return self._nn.call("delete", path=path)
+    @staticmethod
+    def _trash_root() -> str:
+        """Keyed to the OS user (fs.trash keys on the HDFS user the same
+        way) — NOT the per-process client id, or every CLI invocation would
+        orphan its own trash dir."""
+        import getpass
+
+        return f"/.Trash/{getpass.getuser()}"
+
+    def delete(self, path: str, skip_trash: bool = True) -> bool:
+        """``skip_trash=False`` moves into the user's trash instead of
+        deleting (the fs.trash interval behavior; `expunge` empties).  Paths
+        already inside the trash are always deleted permanently."""
+        if skip_trash or path.startswith("/.Trash/"):
+            return self._nn.call("delete", path=path)
+        import time as _t
+
+        if not self.exists(path):
+            return False  # same contract as the direct delete
+        name = path.strip("/").replace("/", "%2F")
+        base = f"{self._trash_root()}/{int(_t.time())}-{name}"
+        for attempt in range(100):  # same-second re-delete of a recreated
+            # path: disambiguate like HDFS's .1/.2 suffixes
+            dst = base if attempt == 0 else f"{base}.{attempt}"
+            try:
+                return self._nn.call("rename", src=path, dst=dst)
+            except Exception as e:
+                if getattr(e, "error", "") != "FileExistsError":
+                    raise
+        raise IOError(f"could not find a free trash slot for {path}")
+
+    def expunge(self, older_than_s: float = 0.0) -> int:
+        """Delete trash entries older than ``older_than_s`` (dfs -expunge)."""
+        import time as _t
+
+        removed = 0
+        try:
+            entries = self.ls(self._trash_root())
+        except Exception as e:
+            if getattr(e, "error", "") == "FileNotFoundError":
+                return 0  # nothing ever trashed
+            raise
+        cutoff = _t.time() - older_than_s
+        for e in entries:
+            try:
+                ts = int(e["name"].split("-", 1)[0].split(".", 1)[0])
+            except ValueError:
+                continue
+            if ts <= cutoff:
+                if self._nn.call(
+                        "delete", path=f"{self._trash_root()}/{e['name']}"):
+                    removed += 1
+        return removed
 
     def rename(self, src: str, dst: str) -> bool:
         return self._nn.call("rename", src=src, dst=dst)

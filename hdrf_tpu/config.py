@@ -101,6 +101,8 @@ class DataNodeConfig:
     host: str = "127.0.0.1"
     port: int = 0
     data_dir: str = "/tmp/hdrf/data"
+    # Topology label for rack-aware placement (net.topology mapping analog).
+    rack: str = "/default-rack"
     # Packet size on the data-transfer wire (reference default 64 KB).
     packet_size: int = 64 * 1024
     heartbeat_interval_s: float = 1.0

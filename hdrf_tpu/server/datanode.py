@@ -272,7 +272,8 @@ class DataNode:
         for c in ([nn] if nn else self._nns):
             try:
                 c.call("register_datanode", dn_id=self.dn_id,
-                       addr=list(self.addr), sc_path=self._sc.path)
+                       addr=list(self.addr), sc_path=self._sc.path,
+                       rack=self.config.rack)
                 self._send_block_report(c)
                 ok += 1
             except (OSError, ConnectionError):

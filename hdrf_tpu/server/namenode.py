@@ -75,6 +75,7 @@ class DatanodeInfo:
     commands: list[dict] = field(default_factory=list)  # queued for next heartbeat
     stats: dict = field(default_factory=dict)
     sc_path: str | None = None  # short-circuit unix socket (co-located reads)
+    rack: str = "/default-rack"
 
 
 class LeaseManager:
@@ -1002,11 +1003,12 @@ class NameNode:
     # --------------------------------------------------- datanode RPC: control
 
     def rpc_register_datanode(self, dn_id: str, addr: list,
-                              sc_path: str | None = None) -> dict:
+                              sc_path: str | None = None,
+                              rack: str = "/default-rack") -> dict:
         with self._lock:
             self._datanodes[dn_id] = DatanodeInfo(
                 dn_id, (addr[0], addr[1]), last_heartbeat=time.monotonic(),
-                sc_path=sc_path)
+                sc_path=sc_path, rack=rack)
             _M.incr("dn_registered")
             return {"heartbeat_interval_s": self.config.heartbeat_interval_s}
 
@@ -1222,14 +1224,25 @@ class NameNode:
     # ---------------------------------------------------------- block mgmt
 
     def _choose_targets(self, n: int, exclude: set[str]) -> list[DatanodeInfo]:
-        """Placement: random spread over live DNs (BlockPlacementPolicyDefault's
-        rack-awareness collapses to uniform random without topology info)."""
+        """Rack-aware placement (BlockPlacementPolicyDefault-lite): shuffle
+        live DNs, then round-robin across racks so replicas/shards spread
+        over failure domains before doubling up within one."""
         now = time.monotonic()
         live = [d for d in self._datanodes.values()
                 if now - d.last_heartbeat < self.config.dead_node_interval_s
                 and d.dn_id not in exclude]
         random.shuffle(live)
-        return live[:n]
+        by_rack: dict[str, list[DatanodeInfo]] = {}
+        for d in live:
+            by_rack.setdefault(d.rack, []).append(d)
+        racks = list(by_rack.values())
+        random.shuffle(racks)
+        out: list[DatanodeInfo] = []
+        while len(out) < n and any(racks):
+            for r in racks:
+                if r and len(out) < n:
+                    out.append(r.pop())
+        return out
 
     # -------------------------------------------------------------------- HA
 

@@ -109,10 +109,16 @@ def cmd_dfs(args) -> int:
         elif args.op == "-cat":
             sys.stdout.buffer.write(c.read(args.args[0]))
         elif args.op == "-rm":
-            ok = c.delete(args.args[0])
-            if not ok:
-                print(f"no such path: {args.args[0]}", file=sys.stderr)
+            paths = [a for a in args.args if a != "-skipTrash"]
+            if not paths:
+                print("usage: -rm [-skipTrash] <path>", file=sys.stderr)
                 return 1
+            ok = c.delete(paths[0], skip_trash="-skipTrash" in args.args)
+            if not ok:
+                print(f"no such path: {paths[0]}", file=sys.stderr)
+                return 1
+        elif args.op == "-expunge":
+            print(f"removed {c.expunge()} trash entries")
         elif args.op == "-mv":
             c.rename(args.args[0], args.args[1])
         elif args.op == "-stat":

@@ -145,3 +145,54 @@ class TestFailure:
                         time.sleep(0.2)
                 else:
                     pytest.fail("file unreadable after NN restart")
+
+
+class TestPlacementAndTrash:
+    def test_rack_aware_placement(self, tmp_path):
+        from hdrf_tpu.config import DataNodeConfig, NameNodeConfig
+        from hdrf_tpu.server.datanode import DataNode
+        from hdrf_tpu.server.namenode import NameNode
+        from hdrf_tpu.client.filesystem import HdrfClient
+        import os
+
+        nn = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "nn"),
+                                     replication=2,
+                                     block_size=64 * 1024)).start()
+        dns = []
+        try:
+            for i in range(4):
+                cfg = DataNodeConfig(
+                    data_dir=str(tmp_path / f"dn{i}"),
+                    rack=f"/rack{i % 2}", heartbeat_interval_s=0.2)
+                dns.append(DataNode(cfg, nn.addr, dn_id=f"dn-{i}").start())
+            with HdrfClient(nn.addr, name="rack") as c:
+                for i in range(6):
+                    c.write(f"/r/f{i}", b"z" * 10_000)
+                    loc = c._nn.call("get_block_locations", path=f"/r/f{i}")
+                    racks = {nn._datanodes[ld["dn_id"]].rack
+                             for ld in loc["blocks"][0]["locations"]}
+                    assert len(racks) == 2, f"replicas on one rack: {racks}"
+        finally:
+            for dn in dns:
+                dn.stop()
+            nn.stop()
+
+    def test_trash_and_expunge(self, cluster):
+        with cluster.client("trash") as c:
+            root = c._trash_root()
+            c.write("/t/doomed", b"bytes" * 1000)
+            c.delete("/t/doomed", skip_trash=False)
+            assert not c.exists("/t/doomed")
+            # same-second re-delete of a recreated path disambiguates
+            c.write("/t/doomed", b"again")
+            c.delete("/t/doomed", skip_trash=False)
+            trash = c.ls(root)
+            assert len(trash) == 2
+            names = sorted(e["name"] for e in trash)
+            restored = c.read(f"{root}/{names[0]}")
+            assert restored == b"bytes" * 1000
+            # -rm of a trash entry is a permanent delete, not a re-trash
+            assert c.delete(f"{root}/{names[1]}", skip_trash=False)
+            assert len(c.ls(root)) == 1
+            assert c.expunge() == 1
+            assert c.ls(root) == []
