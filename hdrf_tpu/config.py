@@ -94,6 +94,9 @@ class NameNodeConfig:
     # Block access tokens (dfs.block.access.token.enable analog): NN mints
     # HMAC tokens, DNs verify; keys ride heartbeat responses.
     block_tokens: bool = False
+    # Startup safemode: hold mutations until this fraction of known blocks
+    # has a reported replica (dfs.namenode.safemode.threshold-pct analog).
+    safemode_threshold: float = 0.999
 
 
 @dataclass

@@ -146,6 +146,11 @@ class NameNode:
         self._alloc_charge: dict[int, tuple[str, int]] = {}  # bid -> (path, bytes)
         self._events: list[dict] = []   # inotify ring (active only)
         self._events_cap = 10_000
+        self._decommissioning: set[str] = set()
+        self._safemode_forced = False
+        # auto safemode on startup when a non-empty namespace was loaded:
+        # hold mutations until enough replicas have reported in
+        self._safemode_auto = False
         self._events_trimmed = 0        # events up to this seq were dropped
         self._pending_space: dict[str, int] = {}   # quota root -> charged bytes
         # Snapshots: frozen subtree images per snapshottable dir
@@ -166,6 +171,8 @@ class NameNode:
         self._editlog = EditLog(self.config.meta_dir,
                                 self.config.editlog_checkpoint_every)
         self._load()
+        self._load_decommissioning()
+        self._safemode_auto = bool(self._blocks) and self.role == "active"
         self._rpc = RpcServer(self.config.host, self.config.port, self, "namenode")
         self._monitor_stop = threading.Event()
         self._monitor: threading.Thread | None = None
@@ -391,6 +398,7 @@ class NameNode:
 
         if self.role != "active":
             raise StandbyError("namenode is standby")
+        self._check_safemode()
         self._validate(rec)
         try:
             self._editlog.append(rec)
@@ -1230,7 +1238,8 @@ class NameNode:
         now = time.monotonic()
         live = [d for d in self._datanodes.values()
                 if now - d.last_heartbeat < self.config.dead_node_interval_s
-                and d.dn_id not in exclude]
+                and d.dn_id not in exclude
+                and d.dn_id not in self._decommissioning]
         random.shuffle(live)
         by_rack: dict[str, list[DatanodeInfo]] = {}
         for d in live:
@@ -1245,6 +1254,128 @@ class NameNode:
         return out
 
     # -------------------------------------------------------------------- HA
+
+    # -------------------------------------------------------------- safemode
+
+    def _in_safemode(self) -> bool:
+        if self._safemode_forced:
+            return True
+        if not self._safemode_auto:
+            return False
+        # auto safemode: leave once the reported fraction of known completed
+        # blocks reaches the threshold (SafeModeInfo analog)
+        total = known = 0
+        dns = set(self._datanodes)
+        for info in self._blocks.values():
+            if info.length < 0:
+                continue
+            total += 1
+            if info.locations & dns:
+                known += 1
+        if total == 0 or known / total >= self.config.safemode_threshold:
+            self._safemode_auto = False
+            _M.incr("safemode_left")
+            return self._safemode_forced
+        return True
+
+    def _check_safemode(self) -> None:
+        if self._in_safemode():
+            raise OSError("NameNode is in safe mode")
+
+    def rpc_safemode(self, action: str = "get") -> bool:
+        """dfsadmin -safemode get|enter|leave|forceExit analog."""
+        with self._lock:
+            if action == "enter":
+                self._safemode_forced = True
+            elif action in ("leave", "forceExit"):
+                self._safemode_forced = False
+                self._safemode_auto = False
+            return self._in_safemode()
+
+    # ----------------------------------------------------------- decommission
+
+    def rpc_decommission(self, dn_id: str) -> bool:
+        """Begin draining a DN (DecommissionManager analog): it stays live
+        for reads and as a re-replication source, is excluded from new
+        placements, and its blocks are re-replicated elsewhere; poll
+        rpc_decommission_status for completion, then stop the DN."""
+        with self._lock:
+            if dn_id not in self._datanodes:
+                return False
+            self._decommissioning.add(dn_id)
+            self._save_decommissioning()
+            _M.incr("decommissions_started")
+            return True
+
+    def rpc_recommission(self, dn_id: str) -> bool:
+        """Return a drained (or repaired) DN to service — clears the exclude
+        state so placement uses it again (refreshNodes-after-edit analog)."""
+        with self._lock:
+            if dn_id not in self._decommissioning:
+                return False
+            self._decommissioning.discard(dn_id)
+            self._save_decommissioning()
+            return True
+
+    def _save_decommissioning(self) -> None:
+        """The exclude set persists like the reference's hosts-exclude file:
+        a sidecar in the (HA-shared) meta dir, so restarts and promoted
+        standbys keep honoring an in-progress drain."""
+        import json
+        import os
+
+        path = os.path.join(self.config.meta_dir, "decommissioning.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(sorted(self._decommissioning), f)
+        os.replace(tmp, path)
+
+    def _load_decommissioning(self) -> None:
+        import json
+        import os
+
+        path = os.path.join(self.config.meta_dir, "decommissioning.json")
+        try:
+            with open(path) as f:
+                self._decommissioning = set(json.load(f))
+        except (FileNotFoundError, ValueError):
+            self._decommissioning = set()
+
+    def rpc_decommission_status(self, dn_id: str) -> dict:
+        """'decommissioning' while blocks still need copies elsewhere;
+        'decommissioned' when every hosted block is safe without this DN."""
+        with self._lock:
+            dn = self._datanodes.get(dn_id)
+            if dn is None:
+                return {"state": "dead", "remaining": 0}
+            if dn_id not in self._decommissioning:
+                return {"state": "normal", "remaining": 0}
+            ec_bids = {b for g in self._groups.values() for b in g.bids}
+            avail = sum(1 for d in self._datanodes
+                        if d not in self._decommissioning)
+            remaining = sum(1 for bid in dn.blocks
+                            if not self._safe_without(bid, dn_id, ec_bids,
+                                                      avail))
+            return {"state": ("decommissioned" if remaining == 0
+                              else "decommissioning"),
+                    "remaining": remaining}
+
+    def _safe_without(self, bid: int, dn_id: str, ec_bids: set[int],
+                      avail: int) -> bool:
+        info = self._blocks.get(bid)
+        if info is None:
+            return True
+        node = self._try_file(info.path)
+        want = node.replication if node else 1
+        if bid in ec_bids:
+            want = 1  # EC internal blocks carry one replica each
+        # Cap by cluster capacity (DecommissionManager's isSufficient): a
+        # 3-replica block on a 3-node cluster must not pin the drain forever.
+        want = min(want, max(avail, 1))
+        others = {d for d in info.locations
+                  if d in self._datanodes and d != dn_id
+                  and d not in self._decommissioning}
+        return len(others) >= want
 
     # --------------------------------------------------------------- inotify
 
@@ -1295,6 +1426,10 @@ class NameNode:
                                reload_fn=self._reload_image)
             self._drain_pending_ibr()
             self._editlog.open_for_append(self._snapshot)
+            self._load_decommissioning()
+            # same protection as a cold start: hold mutations until enough
+            # replicas are known (a warm standby lifts this immediately)
+            self._safemode_auto = bool(self._blocks)
             self.role = "active"
         mon = threading.Thread(target=self._monitor_loop, name="nn-monitor",
                                daemon=True)
@@ -1351,13 +1486,16 @@ class NameNode:
             self._check_ec_groups(now)
             ec_bids = {b for g in self._groups.values() for b in g.bids}
             for info in self._blocks.values():
-                if info.block_id in ec_bids:
-                    continue  # EC internal blocks are reconstructed, not copied
                 node = self._try_file(info.path)
                 if node is None or not node.complete:
                     continue
+                # EC internal blocks: zero-location loss is handled by
+                # _check_ec_groups (reconstruction); a draining host still
+                # holds live bytes, so the drain is a plain 1-replica copy.
+                want = 1 if info.block_id in ec_bids else node.replication
                 live = {d for d in info.locations if d in self._datanodes}
-                deficit = node.replication - len(live)
+                counted = live - self._decommissioning
+                deficit = want - len(counted)
                 if deficit <= 0 or not live:
                     self._pending_repl.pop(info.block_id, None)
                     continue

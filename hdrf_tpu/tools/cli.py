@@ -11,6 +11,7 @@ dispatch; DFSAdmin, OfflineImageViewer / OfflineEditsViewer under
                            -createSnapshot -deleteSnapshot -lsSnapshots
   dfsadmin                 -report -savenamespace -metrics -movblock
                            -allowSnapshot -setQuota -setSpaceQuota -clrQuota
+                           -safemode -decommission -decommissionStatus
                            -haState -transitionToActive
   oiv / oev                offline fsimage / edit-log viewers
   balancer                 spread replicas toward the mean DN utilization
@@ -168,6 +169,20 @@ def cmd_dfsadmin(args) -> int:
             c.set_quota(args.args[1], space_quota=int(args.args[0]))
         elif args.op == "-clrQuota":
             c.set_quota(args.args[0])
+        elif args.op == "-safemode":
+            mode = args.args[0] if args.args else "get"
+            on = c._nn.call("safemode", action=mode)
+            print(f"Safe mode is {'ON' if on else 'OFF'}")
+        elif args.op == "-decommission":
+            ok = c._nn.call("decommission", dn_id=args.args[0])
+            print("decommissioning" if ok else "unknown datanode")
+            return 0 if ok else 1
+        elif args.op == "-recommission":
+            ok = c._nn.call("recommission", dn_id=args.args[0])
+            print("recommissioned" if ok else "was not decommissioning")
+        elif args.op == "-decommissionStatus":
+            st = c._nn.call("decommission_status", dn_id=args.args[0])
+            print(f"{args.args[0]}: {st['state']} remaining={st['remaining']}")
         elif args.op == "-haState":
             from hdrf_tpu.proto.rpc import RpcClient
             for a in args.args or [args.namenode]:

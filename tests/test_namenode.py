@@ -237,3 +237,88 @@ class TestWalIntegrity:
         cmds = [c for d in nn._datanodes.values() for c in d.commands
                 if c["cmd"] == "replicate"]
         assert len(cmds) == 1
+
+
+class TestSafemodeAndDecommission:
+    def test_startup_safemode_until_reports(self, tmp_path):
+        cfg = NameNodeConfig(meta_dir=str(tmp_path / "name"), replication=1)
+        nn = NameNode(cfg)
+        register(nn, 1)
+        nn.rpc_create("/f", client="c1")
+        a = nn.rpc_add_block("/f", client="c1")
+        nn.rpc_block_received("dn-0", a["block_id"], 7)
+        assert nn.rpc_complete("/f", client="c1",
+                               block_lengths={a["block_id"]: 7})
+        nn._editlog.close()
+        # restart over the same meta dir: non-empty namespace => safemode
+        nn2 = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "name"),
+                                      replication=1))
+        assert nn2.rpc_safemode("get") is True
+        with pytest.raises(OSError, match="safe mode"):
+            nn2.rpc_mkdir("/blocked")
+        # a block report satisfies the threshold and safemode lifts
+        nn2.rpc_register_datanode("dn-0", ["h0", 1000])
+        nn2.rpc_block_report("dn-0", [[a["block_id"], a["gen_stamp"], 7]])
+        assert nn2.rpc_safemode("get") is False
+        nn2.rpc_mkdir("/unblocked")
+        # manual enter/leave
+        nn2.rpc_safemode("enter")
+        with pytest.raises(OSError, match="safe mode"):
+            nn2.rpc_delete("/unblocked")
+        nn2.rpc_safemode("leave")
+        assert nn2.rpc_delete("/unblocked")
+        nn2._editlog.close()
+
+    def test_decommission_drains_and_completes(self, nn):
+        register(nn, 3)
+        nn.rpc_create("/f", client="c1")
+        a = nn.rpc_add_block("/f", client="c1")
+        bid = a["block_id"]
+        nn.rpc_block_received("dn-0", bid, 10)
+        nn.rpc_block_received("dn-1", bid, 10)
+        assert nn.rpc_complete("/f", client="c1", block_lengths={bid: 10})
+        assert nn.rpc_decommission("dn-0")
+        st = nn.rpc_decommission_status("dn-0")
+        assert st["state"] == "decommissioning" and st["remaining"] == 1
+        # decommissioning nodes are excluded from new placements
+        targets = nn._choose_targets(3, exclude=set())
+        assert all(t.dn_id != "dn-0" for t in targets)
+        # the monitor schedules a replacement copy (replication=2, one
+        # counted replica left on dn-1)
+        nn._check_replication()
+        cmds = [c for d in nn._datanodes.values() for c in d.commands
+                if c["cmd"] == "replicate"]
+        assert cmds and cmds[0]["block_id"] == bid
+        # replica lands on dn-2 -> dn-0 is safe to stop
+        nn.rpc_block_received("dn-2", bid, 10)
+        assert nn.rpc_decommission_status("dn-0")["state"] == "decommissioned"
+
+    def test_ec_shard_drain_and_recommission(self, nn, tmp_path):
+        register(nn, 5)
+        nn.rpc_create("/e", client="c1", ec="rs-3-2-4k")
+        alloc = nn.rpc_add_block_group("/e", client="c1")
+        gid = alloc["group_id"]
+        for i, blk in enumerate(alloc["blocks"]):
+            nn.rpc_block_received(f"dn-{i % 5}", blk["block_id"], 4096)
+        assert nn.rpc_complete("/e", client="c1", block_lengths={gid: 12288})
+        assert nn.rpc_decommission("dn-0")
+        # the monitor schedules a plain copy of the EC shard off dn-0
+        nn._check_replication()
+        cmds = [c for d in nn._datanodes.values() for c in d.commands
+                if c["cmd"] == "replicate"]
+        shard_on_dn0 = next(b["block_id"] for i, b in
+                            enumerate(alloc["blocks"]) if i % 5 == 0)
+        assert any(c["block_id"] == shard_on_dn0 for c in cmds)
+        # replica lands elsewhere -> drain completes
+        nn.rpc_block_received("dn-3", shard_on_dn0, 4096)
+        st = nn.rpc_decommission_status("dn-0")
+        assert st["state"] == "decommissioned", st
+        # recommission returns the node to placement
+        assert nn.rpc_recommission("dn-0")
+        assert nn.rpc_decommission_status("dn-0")["state"] == "normal"
+        # the exclude set survives a restart over the same meta dir
+        nn.rpc_decommission("dn-1")
+        nn._editlog.close()
+        nn2 = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "name")))
+        assert "dn-1" in nn2._decommissioning
+        nn2._editlog.close()
