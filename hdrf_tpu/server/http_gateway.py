@@ -49,6 +49,14 @@ class HttpGateway:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _html(self, body: str) -> None:
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def _bytes(self, data: bytes) -> None:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")
@@ -61,6 +69,9 @@ class HttpGateway:
                 u = urlparse(self.path)
                 q = {k: v[0] for k, v in parse_qs(u.query).items()}
                 try:
+                    if u.path == "/explorer":
+                        return self._html(gateway.explorer(
+                            q.get("path", "/")))
                     if u.path == "/status":
                         return self._json(200, gateway.status())
                     if u.path == "/metrics":
@@ -143,3 +154,43 @@ class HttpGateway:
     def metrics(self) -> dict:
         with HdrfClient(self._nn_addr, name="http-gw") as c:
             return c._nn.call("metrics")
+
+    def explorer(self, path: str) -> str:
+        """Minimal namespace browser (the NN webapp's explorer.html analog).
+        Paths are URL-quoted inside hrefs AND html-escaped as attribute
+        values: legal filenames contain &, #, %, quotes — unencoded they
+        break links and open an attribute-injection (XSS) hole."""
+        import html
+        from urllib.parse import quote
+
+        def href(url: str) -> str:
+            return html.escape(url, quote=True)
+
+        with HdrfClient(self._nn_addr, name="http-gw") as c:
+            entries = c.ls(path)
+        base = path.rstrip("/")
+        rows = []
+        for e in sorted(entries, key=lambda x: (x["type"] != "dir", x["name"])):
+            name = html.escape(e["name"])
+            child = f"{base}/{e['name']}"
+            if e["type"] == "dir":
+                url = "/explorer?path=" + quote(child, safe="")
+                link = f'<a href="{href(url)}">{name}/</a>'
+                size = ""
+            else:
+                url = "/webhdfs/v1" + quote(child) + "?op=OPEN"
+                link = f'<a href="{href(url)}">{name}</a>'
+                size = f"{e.get('length', 0):,}"
+            extra = e.get("scheme", "") if e["type"] == "file" else ""
+            rows.append(f"<tr><td>{link}</td><td align=right>{size}</td>"
+                        f"<td>{html.escape(str(extra))}</td></tr>")
+        up = base.rsplit("/", 1)[0] or "/"
+        up_url = "/explorer?path=" + quote(up, safe="")
+        return (f"<html><head><title>hdrf {html.escape(path)}</title></head>"
+                f"<body><h2>hdrf_tpu — {html.escape(path)}</h2>"
+                f'<p><a href="{href(up_url)}">[up]</a> '
+                f'<a href="/status">[status]</a> '
+                f'<a href="/metrics">[metrics]</a></p>'
+                f"<table border=0 cellpadding=4>"
+                f"<tr><th>name</th><th>size</th><th>scheme</th></tr>"
+                f"{''.join(rows)}</table></body></html>")

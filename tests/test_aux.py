@@ -117,6 +117,9 @@ class TestHttpGateway:
                 base + "/webhdfs/v1/web/g?op=DELETE", method="DELETE")
             with urllib.request.urlopen(req) as r:
                 assert json.loads(r.read())["boolean"]
+            # HTML explorer renders the namespace
+            page = get("/explorer?path=/web").decode()
+            assert "hdrf_tpu" in page and "d/" in page
             # errors surface as structured JSON
             try:
                 get("/webhdfs/v1/nope?op=GETFILESTATUS")
