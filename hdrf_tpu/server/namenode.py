@@ -800,6 +800,25 @@ class NameNode:
             _M.incr("complete")
             return True
 
+    def rpc_recover_lease(self, path: str) -> bool:
+        """Force lease recovery on ``path`` (DFSAdmin recoverLease /
+        DistributedFileSystem.recoverLease analog): drop the writer's lease
+        and finalize the file with the block lengths reports gave us.
+        Returns True when the file is closed afterwards."""
+        with self._lock:
+            node = self._file(path)
+            self._leases.drop("/" + "/".join(self._parts(path)))
+            self._leases.drop(path)
+            if not node.complete:
+                lengths = {b: max(self._blocks[b].length, 0)
+                           for b in node.blocks if b in self._blocks}
+                if node.ec:
+                    lengths = {g: max(self._groups[g].logical_len, 0)
+                               for g in node.blocks if g in self._groups}
+                self._log(["complete", path, lengths, time.time()])
+                _M.incr("leases_recovered")
+            return self._file(path).complete
+
     def rpc_renew_lease(self, client: str) -> bool:
         with self._lock:
             self._leases.renew_all(client)

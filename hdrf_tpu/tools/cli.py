@@ -169,6 +169,9 @@ def cmd_dfsadmin(args) -> int:
             c.set_quota(args.args[1], space_quota=int(args.args[0]))
         elif args.op == "-clrQuota":
             c.set_quota(args.args[0])
+        elif args.op == "-recoverLease":
+            ok = c._nn.call("recover_lease", path=args.args[0])
+            print("recovered" if ok else "not recovered")
         elif args.op == "-safemode":
             mode = args.args[0] if args.args else "get"
             on = c._nn.call("safemode", action=mode)

@@ -322,3 +322,15 @@ class TestSafemodeAndDecommission:
         nn2 = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "name")))
         assert "dn-1" in nn2._decommissioning
         nn2._editlog.close()
+
+    def test_recover_lease_rpc(self, nn):
+        register(nn)
+        nn.rpc_create("/rl", client="c1")
+        a = nn.rpc_add_block("/rl", client="c1")
+        nn.rpc_block_received("dn-0", a["block_id"], 42)
+        # writer vanishes without complete(); admin forces recovery
+        assert nn.rpc_recover_lease("/rl") is True
+        st = nn.rpc_stat("/rl")
+        assert st["complete"] and st["length"] == 42
+        # path is writable by a new client afterwards
+        nn.rpc_create("/rl2", client="c2")
