@@ -166,6 +166,12 @@ class TestPlacementAndTrash:
                     rack=f"/rack{i % 2}", heartbeat_interval_s=0.2)
                 dns.append(DataNode(cfg, nn.addr, dn_id=f"dn-{i}").start())
             with HdrfClient(nn.addr, name="rack") as c:
+                import time
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if sum(d["alive"] for d in c.datanode_report()) == 4:
+                        break
+                    time.sleep(0.05)
                 for i in range(6):
                     c.write(f"/r/f{i}", b"z" * 10_000)
                     loc = c._nn.call("get_block_locations", path=f"/r/f{i}")
