@@ -1,0 +1,52 @@
+"""One source of truth for the corrected XLA:CPU process environment.
+
+The dev tunnel's sitecustomize force-registers its TPU backend whenever
+``PALLAS_AXON_POOL_IPS`` is present, and platform selection only takes
+effect via process env at interpreter start — so any code that needs a
+true n-device XLA:CPU mesh (tests/conftest.py, __graft_entry__'s dryrun)
+must re-exec a child with the env built here.  Keeping the recipe in one
+place means a future tunnel change is fixed once, not per-caller.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def clean_cpu_env(n_devices: int, base: dict | None = None,
+                  keep_existing_count: bool = False) -> dict:
+    """Env dict for a child process with ``n_devices`` virtual CPU devices.
+
+    ``keep_existing_count=True`` preserves an operator-set
+    ``--xla_force_host_platform_device_count`` flag (``n_devices`` is then
+    only the default); ``False`` forces exactly ``n_devices``.
+    """
+    env = dict(os.environ if base is None else base)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _with_device_count_flag(
+        env.get("XLA_FLAGS", ""), n_devices, keep_existing_count)
+    return env
+
+
+def ensure_device_count_flag(n_devices: int) -> None:
+    """Append the virtual-device-count flag to os.environ if absent."""
+    os.environ["XLA_FLAGS"] = _with_device_count_flag(
+        os.environ.get("XLA_FLAGS", ""), n_devices, keep_existing=True)
+
+
+def _with_device_count_flag(flags_str: str, n_devices: int,
+                            keep_existing: bool) -> str:
+    flags = flags_str.split()
+    existing = [f for f in flags
+                if "xla_force_host_platform_device_count" in f]
+    if existing and keep_existing:
+        return flags_str
+    flags = [f for f in flags if f not in existing]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    return " ".join(flags)
+
+
+def env_is_tunneled() -> bool:
+    """True when the axon sitecustomize will hijack platform selection."""
+    return "PALLAS_AXON_POOL_IPS" in os.environ

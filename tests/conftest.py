@@ -19,27 +19,26 @@ import os
 import subprocess
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hdrf_tpu.utils.cleanenv import env_is_tunneled  # noqa: E402
+
+# JAX_PLATFORMS=cpu alone is not enough: the axon sitecustomize
+# force-registers the tunnel backend whenever the pool var is present.
 _WRONG_ENV = (os.environ.get("HDRF_TEST_TPU") != "1"
               and (os.environ.get("JAX_PLATFORMS") != "cpu"
-                   # JAX_PLATFORMS=cpu alone is not enough: the axon
-                   # sitecustomize force-registers the tunnel backend
-                   # whenever the pool var is present.
-                   or "PALLAS_AXON_POOL_IPS" in os.environ))
+                   or env_is_tunneled()))
 
 
 def pytest_configure(config):
     if not _WRONG_ENV or config.option.collectonly:
         return
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    # Without this the tunnel's sitecustomize registers the axon backend,
-    # which force-selects jax_platforms="axon,cpu" no matter what the env
-    # says; the CPU suite must not touch the tunnel at all.
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
+    # Shared recipe (also used by __graft_entry__.dryrun_multichip): drop the
+    # tunnel's pool var so its sitecustomize can't register the axon backend,
+    # select XLA:CPU at interpreter start, default 8 virtual devices while
+    # honoring an operator-set device-count flag.
+    from hdrf_tpu.utils.cleanenv import clean_cpu_env
+    env = clean_cpu_env(8, keep_existing_count=True)
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:
         capman.suspend_global_capture(in_=True)
@@ -48,12 +47,9 @@ def pytest_configure(config):
     os._exit(rc)
 
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+from hdrf_tpu.utils.cleanenv import ensure_device_count_flag  # noqa: E402
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+ensure_device_count_flag(8)
 
 import pytest  # noqa: E402
 
