@@ -316,7 +316,8 @@ class HdrfClient:
             for loc in locations:
                 sc = loc.get("sc_path")
                 if sc and loc["addr"][0] in ("127.0.0.1", "localhost"):
-                    data = read_local(sc, binfo["block_id"], offset, length)
+                    data = read_local(sc, binfo["block_id"], offset, length,
+                                      token=binfo.get("token"))
                     if data is not None:
                         _M.incr("short_circuit_reads")
                         return data

@@ -103,10 +103,18 @@ class EditLog:
         return n
 
     def tail(self, apply_fn: Callable[[list], None],
-             reload_fn: Callable[[Any], None] | None = None) -> int:
+             reload_fn: Callable[[Any], None] | None = None,
+             readonly: bool = True) -> int:
         """Standby-side incremental catch-up (EditLogTailer.java:74 analog):
         if the active has published a newer fsimage (its checkpoint truncated
-        the WAL), reload it first, then apply WAL records past ``seq``."""
+        the WAL), reload it first, then apply WAL records past ``seq``.
+
+        A standby tails ``readonly`` (the torn tail it sees may be the
+        active's write in flight).  The final catch-up during promotion must
+        pass ``readonly=False``: the caller has claimed the epoch and is the
+        sole journal writer, and appending behind a torn frame would make
+        every subsequently acked edit unreachable to replay (wal.scan stops
+        at the first corrupt frame) — silent namespace loss on restart."""
         img = os.path.join(self._dir, IMG_NAME)
         if os.path.exists(img) and reload_fn is not None:
             with open(img, "rb") as f:
@@ -115,7 +123,7 @@ class EditLog:
             if seq > self.seq:
                 reload_fn(snapshot)
                 self.seq = seq
-        return self.replay(apply_fn, readonly=True)
+        return self.replay(apply_fn, readonly=readonly)
 
     def open_for_append(self, snapshot_fn: Callable[[], Any]) -> None:
         """``snapshot_fn`` is called at auto-checkpoint time to capture the
@@ -184,20 +192,25 @@ class EditLog:
 
     def checkpoint(self) -> None:
         # Fenced like append: a split-brain old active must never overwrite
-        # the fsimage or truncate the shared WAL after a promotion.
+        # the fsimage or truncate the shared WAL after a promotion.  The
+        # fence lock is held across the WHOLE checkpoint (snapshot, image
+        # publish, WAL truncate) — releasing it after the check would let a
+        # concurrent claim_epoch land between the check and the truncate,
+        # and the old active would then erase edits the new active already
+        # fsync'd and acked.
         with self._fence_lock():
             self._check_fence()
-        snapshot = self._snapshot_fn() if self._snapshot_fn else None
-        tmp = os.path.join(self._dir, IMG_TMP)
-        with open(tmp, "wb") as f:
-            f.write(msgpack.packb([self.seq, snapshot]))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(self._dir, IMG_NAME))
-        fault_injection.point("editlog.post_checkpoint")
-        if self._wal is not None:
-            self._wal.truncate(0)
-            self._wal.seek(0)
+            snapshot = self._snapshot_fn() if self._snapshot_fn else None
+            tmp = os.path.join(self._dir, IMG_TMP)
+            with open(tmp, "wb") as f:
+                f.write(msgpack.packb([self.seq, snapshot]))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self._dir, IMG_NAME))
+            fault_injection.point("editlog.post_checkpoint")
+            if self._wal is not None:
+                self._wal.truncate(0)
+                self._wal.seek(0)
         self._ops_since_ckpt = 0
 
     def close(self) -> None:

@@ -86,12 +86,16 @@ class LeaseManager:
         self.expiry_s = expiry_s
         self._leases: dict[str, tuple[str, float]] = {}  # path -> (client, deadline)
 
-    def acquire(self, path: str, client: str) -> None:
+    def check_available(self, path: str, client: str) -> None:
+        """Raise iff another client holds a live lease (non-mutating — safe
+        to call before the op is durably logged)."""
         holder = self._leases.get(path)
-        now = time.monotonic()
-        if holder and holder[0] != client and holder[1] > now:
+        if holder and holder[0] != client and holder[1] > time.monotonic():
             raise PermissionError(f"{path} leased by {holder[0]}")
-        self._leases[path] = (client, now + self.expiry_s)
+
+    def acquire(self, path: str, client: str) -> None:
+        self.check_available(path, client)
+        self._leases[path] = (client, time.monotonic() + self.expiry_s)
 
     def check(self, path: str, client: str) -> None:
         holder = self._leases.get(path)
@@ -141,7 +145,8 @@ class NameNode:
         self._datanodes: dict[str, DatanodeInfo] = {}
         self._leases = LeaseManager()
         self._pending_repl: dict[int, float] = {}  # block_id -> retry deadline
-        self._pending_moves: dict[int, str] = {}   # balancer: block -> old DN
+        # balancer moves in flight: block -> {"from", "to", "deadline"}
+        self._pending_moves: dict[int, dict] = {}
         self._pending_ibr: dict[int, list] = {}    # standby: IBRs ahead of tail
         self._alloc_charge: dict[int, tuple[str, int]] = {}  # bid -> (path, bytes)
         self._events: list[dict] = []   # inotify ring (active only)
@@ -709,13 +714,19 @@ class NameNode:
                 if existing.complete:
                     raise FileExistsError(path)
             self._check_ns_quota(path)
-            self._leases.acquire(path, client)
+            # Check (non-mutating) before logging, acquire only after: _log
+            # raises StandbyError/FencedError on a non-active NN, and a lease
+            # granted before that check would sit un-expirable on the standby
+            # (lease recovery only runs on the active), spuriously blocking
+            # creates after a promotion.
+            self._leases.check_available(path, client)
             if existing is not None:
                 # Overwriting an abandoned incomplete file: drop it first so
                 # its allocated blocks are invalidated on DNs rather than
                 # leaking in the block map forever.
                 self._log(["delete", path])
             self._log(["create", path, replication, scheme, time.time(), ec])
+            self._leases.acquire(path, client)
             _M.incr("create")
             return {"block_size": self.config.block_size, "scheme": scheme,
                     "replication": replication, "ec": ec}
@@ -1224,25 +1235,35 @@ class NameNode:
                 "cmd": "replicate", "block_id": block_id,
                 "gen_stamp": info.gen_stamp,
                 "targets": [{"dn_id": dst.dn_id, "addr": list(dst.addr)}]})
-            self._pending_moves[block_id] = from_dn
+            self._pending_moves[block_id] = {
+                "from": from_dn, "to": to_dn,
+                "deadline": time.monotonic() + self.MOVE_TIMEOUT_S}
             return True
 
+    MOVE_TIMEOUT_S = 120.0  # abandon a move whose target never reports
+
     def _settle_moves(self) -> None:
-        """Finish balancer moves: when the new replica has reported, drop the
-        old one (never reduce below the current replica count otherwise)."""
+        """Finish balancer moves: only when the REQUESTED target has reported
+        its copy does the source replica get invalidated — "some other
+        replica exists" is not enough, since with replication>=2 that would
+        drop redundancy below target the moment the command is queued.
+        A move whose target never shows up is abandoned at its deadline (the
+        source replica simply stays where it was)."""
         with self._lock:
-            for bid, from_dn in list(self._pending_moves.items()):
+            now = time.monotonic()
+            for bid, mv in list(self._pending_moves.items()):
                 info = self._blocks.get(bid)
-                if info is None or from_dn not in info.locations:
+                if info is None or mv["from"] not in info.locations:
                     self._pending_moves.pop(bid)
                     continue
-                others = info.locations - {from_dn}
-                if any(d in self._datanodes for d in others):
-                    dn = self._datanodes.get(from_dn)
+                if mv["to"] in info.locations and mv["to"] in self._datanodes:
+                    dn = self._datanodes.get(mv["from"])
                     if dn is not None:
                         dn.commands.append({"cmd": "invalidate",
                                             "block_ids": [bid]})
-                    info.locations.discard(from_dn)
+                    info.locations.discard(mv["from"])
+                    self._pending_moves.pop(bid)
+                elif now > mv["deadline"]:
                     self._pending_moves.pop(bid)
 
     def rpc_metrics(self) -> dict:
@@ -1440,9 +1461,14 @@ class NameNode:
             # claim FIRST (fencing the old writer), THEN the final tail —
             # the reverse order loses any edit the not-yet-fenced active
             # appends between the tail and the claim, and reuses its seq.
+            # The tail runs readonly=False: we are now the sole journal
+            # writer, and the torn tail a crashed ex-active left behind must
+            # be truncated before open_for_append, or every edit we append
+            # behind it becomes unreachable to future replays.
             self._editlog.claim_epoch()
             self._editlog.tail(self._apply_tolerant,
-                               reload_fn=self._reload_image)
+                               reload_fn=self._reload_image,
+                               readonly=False)
             self._drain_pending_ibr()
             self._editlog.open_for_append(self._snapshot)
             self._load_decommissioning()
@@ -1517,6 +1543,9 @@ class NameNode:
                 deficit = want - len(counted)
                 if deficit <= 0 or not live:
                     self._pending_repl.pop(info.block_id, None)
+                    if (deficit < 0
+                            and info.block_id not in self._pending_moves):
+                        self._prune_excess(info, counted, want)
                     continue
                 # PendingReconstructionBlocks analog: don't re-queue the same
                 # block every monitor tick while a transfer is in flight.
@@ -1534,6 +1563,44 @@ class NameNode:
                     self._pending_repl[info.block_id] = (
                         now + self.config.pending_replication_timeout_s)
                     _M.incr("replications_scheduled")
+
+    def _prune_excess(self, info, counted: set[str], want: int) -> None:
+        """Drop excess replicas (BlockManager.processExtraRedundancy /
+        chooseReplicaToDelete analog): over-replication arises from
+        re-replication racing a node's return, or a balancer move abandoned
+        at its deadline whose target reported late.  Victim selection must
+        preserve rack diversity (the invariant _choose_targets establishes):
+        only prune from racks holding more than one replica while another
+        rack still has a copy; among eligible victims prefer the fullest
+        node.  Decommissioning nodes' copies are already excluded from
+        ``counted``."""
+        excess = len(counted) - want
+        remaining = set(counted)
+        for _ in range(excess):
+            by_rack: dict[str, list[str]] = {}
+            for d in remaining:
+                by_rack.setdefault(self._datanodes[d].rack, []).append(d)
+            if len(by_rack) > 1:
+                eligible = [d for r, ds in by_rack.items() if len(ds) > 1
+                            for d in ds]
+            else:
+                eligible = list(remaining)
+            if not eligible:
+                # every remaining rack holds exactly one replica: removing
+                # any would shrink rack coverage — prune the fullest anyway
+                # (count still exceeds want) but from the largest rack set.
+                eligible = list(remaining)
+            victim = max(eligible,
+                         key=lambda d: len(self._datanodes[d].blocks))
+            remaining.discard(victim)
+            dn = self._datanodes.get(victim)
+            if dn is None:
+                continue
+            dn.commands.append({"cmd": "invalidate",
+                                "block_ids": [info.block_id]})
+            info.locations.discard(victim)
+            dn.blocks.discard(info.block_id)
+            _M.incr("excess_replicas_pruned")
 
     def _check_ec_groups(self, now: float) -> None:
         """Schedule EC reconstruction for lost internal blocks

@@ -32,6 +32,26 @@ _M = metrics.registry("shortcircuit")
 MAX_REQ = 4096
 
 
+def _entok(token: dict | None) -> dict | None:
+    """Block token for the JSON request: the HMAC sig is bytes, hex it."""
+    if token is None:
+        return None
+    t = dict(token)
+    t["sig"] = bytes(t["sig"]).hex()
+    return t
+
+
+def _detok(token: dict | None) -> dict | None:
+    if token is None or "sig" not in token:
+        return token
+    t = dict(token)
+    try:
+        t["sig"] = bytes.fromhex(t["sig"])
+    except (TypeError, ValueError):
+        pass  # malformed sig: verification will reject it
+    return t
+
+
 class ShortCircuitServer:
     """DN side: serve REQUEST_SHORT_CIRCUIT_FDS on a unix socket."""
 
@@ -74,9 +94,21 @@ class ShortCircuitServer:
         try:
             req = json.loads(conn.recv(MAX_REQ).decode())
             block_id = req["block_id"]
+            # Same gate as the TCP read path: when block tokens are enabled,
+            # REQUEST_SHORT_CIRCUIT_FDS requires a READ token (the reference
+            # enforces this in DataXceiver.requestShortCircuitFds) — a local
+            # process that can reach sc.sock must not bypass authorization.
+            try:
+                self._dn.tokens.verify(_detok(req.get("token")), block_id, "r")
+            except PermissionError:
+                _M.incr("token_rejected")
+                payload = json.dumps({"status": "denied"}).encode()
+                conn.sendall(len(payload).to_bytes(4, "little") + payload)
+                return
             meta = self._dn.replicas.get_meta(block_id)
             if meta is None:
-                conn.sendall(json.dumps({"status": "no_block"}).encode())
+                payload = json.dumps({"status": "no_block"}).encode()
+                conn.sendall(len(payload).to_bytes(4, "little") + payload)
                 return
             resp = {"status": "ok", "scheme": meta.scheme,
                     "logical_len": meta.logical_len,
@@ -108,10 +140,11 @@ class ShortCircuitServer:
 
 
 def read_local(sock_path: str, block_id: int, offset: int,
-               length: int) -> bytes | None:
+               length: int, token: dict | None = None) -> bytes | None:
     """Client side: fetch the replica fd over the unix socket and pread the
     range directly — zero copies through the DN process.  Returns None when
-    short-circuit isn't possible (reduced replica, dead socket, remote DN)."""
+    short-circuit isn't possible (reduced replica, dead socket, remote DN,
+    missing/invalid block token)."""
     try:
         conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         conn.settimeout(10)
@@ -120,7 +153,8 @@ def read_local(sock_path: str, block_id: int, offset: int,
         return None
     fds: list[int] = []
     try:
-        conn.sendall(json.dumps({"block_id": block_id}).encode())
+        conn.sendall(json.dumps({"block_id": block_id,
+                                 "token": _entok(token)}).encode())
         prefix, fds, _, _ = socket.recv_fds(conn, 4, 1)
         while len(prefix) < 4:
             more = conn.recv(4 - len(prefix))

@@ -40,6 +40,35 @@ class TestShortCircuit:
             c.write("/sc/r", payload, scheme="dedup_lz4")
             assert c.read("/sc/r") == payload  # metadata-only -> TCP path
 
+    def test_fd_passing_requires_token_when_enabled(self, cluster):
+        """With block tokens enabled, REQUEST_SHORT_CIRCUIT_FDS must verify a
+        READ token like the TCP path does — any local process reaching
+        sc.sock must not read arbitrary blocks (DataXceiver's
+        requestShortCircuitFds gate)."""
+        import os
+
+        from hdrf_tpu.server import shortcircuit as scmod
+
+        payload = b"tok" * 50_000
+        with cluster.client("sctok") as c:
+            c.write("/sc/t", payload, scheme="direct")
+            loc = c._nn.call("get_block_locations", path="/sc/t")
+            binfo = loc["blocks"][0]
+            bid = binfo["block_id"]
+            dn_loc = binfo["locations"][0]
+            sc_path = dn_loc["sc_path"]
+            # enable tokens DN-side (normally keys arrive via heartbeat)
+            key = os.urandom(32)
+            for d in cluster.datanodes:
+                if d is not None:
+                    d.tokens.update_keys([key])
+            assert scmod.read_local(sc_path, bid, 0, 100) is None
+            dn = next(d for d in cluster.datanodes
+                      if d is not None and d.dn_id == dn_loc["dn_id"])
+            tok = dn.tokens.mint(bid, "r")
+            assert scmod.read_local(sc_path, bid, 0, 100,
+                                    token=tok) == payload[:100]
+
 
 class TestBlockScanner:
     def test_corrupt_replica_detected_and_rereplicated(self, cluster):

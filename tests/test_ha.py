@@ -114,3 +114,65 @@ class TestFailoverController:
                 assert c.read("/ha/k") == b"m" * 10_000
         finally:
             fc.stop()
+
+
+class TestJournalTornTail:
+    def test_promotion_truncates_torn_tail(self, tmp_path):
+        """Edits appended after a promotion over a torn journal tail (old
+        active crashed mid-append) must be reachable by later replays: the
+        promoting NN truncates the torn frame before opening for append."""
+        import os
+
+        from hdrf_tpu.server.editlog import EditLog
+
+        d = str(tmp_path / "journal")
+        a = EditLog(d)
+        a.load_image()
+        a.replay(lambda rec: None)
+        a.open_for_append(lambda: None)
+        a.claim_epoch()
+        a.append(["mkdir", "/a"])
+        a.append(["mkdir", "/b"])
+        a.close()
+        # crash mid-append: an incomplete frame at the WAL tail
+        with open(os.path.join(d, "edits.wal"), "ab") as f:
+            f.write(b"\x40\x00\x00\x00\x00\x00\x00\x00torn")
+        # promotion: claim the epoch, truncating catch-up, open, append
+        b = EditLog(d)
+        b.load_image()
+        b.claim_epoch()
+        seen = []
+        b.tail(seen.append, readonly=False)
+        assert [r[1] for r in seen] == ["/a", "/b"]
+        b.open_for_append(lambda: None)
+        b.append(["mkdir", "/c"])
+        b.close()
+        # every acked edit survives a cold replay
+        c = EditLog(d)
+        c.load_image()
+        replayed = []
+        c.replay(replayed.append)
+        assert [r[1] for r in replayed] == ["/a", "/b", "/c"]
+        c.close()
+
+    def test_standby_tail_never_truncates(self, tmp_path):
+        """The readonly tail must leave a torn tail in place — it may be the
+        active's append in flight, not a crash artifact."""
+        import os
+
+        from hdrf_tpu.server.editlog import EditLog
+
+        d = str(tmp_path / "journal")
+        a = EditLog(d)
+        a.open_for_append(lambda: None)
+        a.append(["mkdir", "/a"])
+        a.close()
+        wal = os.path.join(d, "edits.wal")
+        with open(wal, "ab") as f:
+            f.write(b"\x40\x00\x00\x00\x00\x00\x00\x00mid")
+        size_before = os.path.getsize(wal)
+        sb = EditLog(d)
+        sb.load_image()
+        sb.tail(lambda rec: None)  # readonly default
+        assert os.path.getsize(wal) == size_before
+        sb.close()

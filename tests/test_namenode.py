@@ -334,3 +334,98 @@ class TestSafemodeAndDecommission:
         assert st["complete"] and st["length"] == 42
         # path is writable by a new client afterwards
         nn.rpc_create("/rl2", client="c2")
+
+
+def completed_block(nn):
+    """Register 3 DNs, create /f, complete one block with replicas on
+    dn-0 and dn-1."""
+    register(nn)
+    nn.rpc_create("/f", client="c1")
+    a = nn.rpc_add_block("/f", client="c1")
+    bid = a["block_id"]
+    nn.rpc_block_received("dn-0", bid, 500)
+    nn.rpc_block_received("dn-1", bid, 500)
+    assert nn.rpc_complete("/f", client="c1", block_lengths={bid: 500})
+    return bid
+
+
+class TestBalancerMoveSafety:
+    """A balancer move must never reduce redundancy: the source replica is
+    dropped only after the REQUESTED target reports its copy (not when any
+    other replica happens to exist), and a move whose target never arrives
+    is abandoned with the source untouched."""
+
+    def test_source_kept_until_target_reports(self, nn):
+        bid = completed_block(nn)
+        assert nn.rpc_move_block(bid, "dn-0", "dn-2")
+        nn._settle_moves()  # dn-1 replica exists, but dn-2 hasn't reported
+        assert "dn-0" in nn._blocks[bid].locations
+        assert bid in nn._pending_moves
+        nn.rpc_block_received("dn-2", bid, 500)
+        nn._settle_moves()
+        locs = nn._blocks[bid].locations
+        assert "dn-2" in locs and "dn-0" not in locs
+        assert bid not in nn._pending_moves
+
+    def test_move_abandoned_after_deadline(self, nn):
+        bid = completed_block(nn)
+        assert nn.rpc_move_block(bid, "dn-0", "dn-2")
+        nn._pending_moves[bid]["deadline"] = 0.0  # force expiry
+        nn._settle_moves()
+        assert bid not in nn._pending_moves
+        assert "dn-0" in nn._blocks[bid].locations  # replica untouched
+
+
+class TestStandbyLeaseHygiene:
+    def test_standby_create_leaves_no_lease(self, tmp_path):
+        """A create rejected by the role check must not leave a lease behind:
+        leases acquired on a standby are never recovered (lease recovery only
+        runs on the active) and would block creates after promotion."""
+        from hdrf_tpu.server.namenode import StandbyError
+
+        cfg = NameNodeConfig(meta_dir=str(tmp_path / "sb"), role="standby")
+        sb = NameNode(cfg)
+        try:
+            with pytest.raises(StandbyError):
+                sb.rpc_create("/f", client="c1")
+            assert "/f" not in sb._leases._leases
+        finally:
+            sb._editlog.close()
+
+
+class TestExcessReplicas:
+    def test_excess_replicas_pruned(self, nn):
+        """Over-replication (re-replication racing a node's return, or an
+        abandoned move whose target reported late) is pruned back to the
+        target count — processExtraRedundancy analog."""
+        bid = completed_block(nn)
+        nn.rpc_block_received("dn-2", bid, 500)  # third copy, want=2
+        assert len(nn._blocks[bid].locations) == 3
+        nn._check_replication()
+        locs = nn._blocks[bid].locations
+        assert len(locs) == 2
+        victim = ({"dn-0", "dn-1", "dn-2"} - locs).pop()
+        cmds = nn._datanodes[victim].commands
+        assert any(c["cmd"] == "invalidate" and bid in c["block_ids"]
+                   for c in cmds)
+
+    def test_excess_prune_preserves_rack_diversity(self, nn):
+        """chooseReplicaToDelete semantics: never prune the last replica on
+        a rack while another rack holds two — one rack failure must not be
+        able to take out the block."""
+        nn.rpc_register_datanode("dn-0", ["h0", 1000], rack="/rackA")
+        nn.rpc_register_datanode("dn-1", ["h1", 1001], rack="/rackA")
+        nn.rpc_register_datanode("dn-2", ["h2", 1002], rack="/rackB")
+        nn.rpc_create("/f", client="c1")
+        a = nn.rpc_add_block("/f", client="c1")
+        bid = a["block_id"]
+        nn.rpc_block_received("dn-0", bid, 500)
+        nn.rpc_block_received("dn-1", bid, 500)
+        assert nn.rpc_complete("/f", client="c1", block_lengths={bid: 500})
+        nn.rpc_block_received("dn-2", bid, 500)  # 3rd copy, want=2
+        # make the rackB node the fullest so naive selection would pick it
+        nn._datanodes["dn-2"].blocks.update({991, 992, 993})
+        nn._check_replication()
+        locs = nn._blocks[bid].locations
+        assert len(locs) == 2
+        assert "dn-2" in locs  # rackB's only copy survived
