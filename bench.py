@@ -32,7 +32,8 @@ import time
 import numpy as np
 
 BLOCK_MB = 64
-N_BLOCKS = 8
+N_BLOCKS = 16
+SUB_BATCHES = 4
 CPU_MB = 32
 
 
@@ -77,7 +78,9 @@ def main() -> None:
     base = _make_block(BLOCK_MB, seed=42)
     cpu_blocks = [_salt(base[: CPU_MB << 20], 100 + i) for i in range(2)]
     _cpu_run([cpu_blocks[0]], cdc)  # page-in warmup
-    cpu_value = max(_cpu_run(cpu_blocks, cdc), _cpu_run(cpu_blocks, cdc))
+    # best of three: the single-thread baseline must reflect an uncontended
+    # core, not whatever else the host was doing during one pass
+    cpu_value = max(_cpu_run(cpu_blocks, cdc) for _ in range(3))
 
     backend = resolve_backend("auto")
     if backend != "tpu":
@@ -93,21 +96,33 @@ def main() -> None:
     from hdrf_tpu.ops.resident import ResidentReducer
 
     r = ResidentReducer(cdc)
-    r.reduce(_salt(base, 99)[: 16 << 20])   # compile small shapes
-    r.reduce(_salt(base, 98))               # compile 64 MiB shapes
-    devs = [jax.device_put(_salt(base, i)) for i in range(N_BLOCKS)]
-    for d in devs:
-        np.asarray(d[:16])                  # force uploads complete
+    stacked = np.stack([_salt(base, i) for i in range(N_BLOCKS)])
+    dev = jax.device_put(stacked)
+    np.asarray(dev[0, :16])                 # force upload complete
+    step = N_BLOCKS // SUB_BATCHES
+    parts = [dev[i * step: (i + 1) * step] for i in range(SUB_BATCHES)]
 
-    # best of two passes: the tunneled transport's dispatch latency varies
+    def one_pass() -> list:
+        # Software-pipelined sub-batches: while sub-batch A's candidate
+        # (then digest) readback is awaited, the other sub-batches'
+        # dispatches execute on device — awaited transfers are the only
+        # non-overlapped cost.
+        bjs = [r.submit_many(h) for h in parts]
+        for bj in bjs:
+            r.start_sha_many(bj)
+        out = []
+        for bj in bjs:
+            out.extend(r.finish_many(bj))
+        return out
+
+    one_pass()                              # compile all batched shapes
+
+    # best of three passes: the tunneled transport's dispatch latency varies
     # run to run; the better pass is closer to the device-bound rate
     value = 0.0
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
-        jobs = [r.submit(d) for d in devs]
-        for j in jobs:
-            r.start_sha(j)
-        results = [r.finish(j) for j in jobs]
+        results = one_pass()
         dt = time.perf_counter() - t0
         assert all(int(cuts[-1]) == BLOCK_MB << 20
                    and digs.shape[0] == cuts.size

@@ -1,0 +1,149 @@
+"""Pallas chunk-gather kernel: variable-offset lanes from the HBM word image.
+
+The XLA formulation (vmapped ``dynamic_slice`` + funnel shift in
+ops/resident._bucket_sha) pays a fixed ~2-5 us per lane — gather machinery,
+not bandwidth — which dominates the reduction pipeline once dispatches are
+batched (PERF_NOTES.md).  This kernel replaces it with per-lane async DMAs
+at ~0.3 us issue cost each:
+
+1. The word image is viewed as (rows, 128) u32; each lane DMAs the rows
+   covering its chunk window (512-byte row granularity, arbitrary row
+   offset — probed supported by Mosaic; arbitrary 1D element offsets are
+   not).
+2. The intra-row word phase (q % 128) is fixed with a dynamic
+   ``pltpu.roll`` pair: roll the lane axis by the phase, then select the
+   wrapped tail from the next sublane row — a flat left-shift of the
+   (rows, 128) window in VPU registers.
+3. The byte phase (offset % 4) is a funnel shift of adjacent words, and the
+   SHA-256 padding (0x80 marker, zero fill, 64-bit bit length) is spliced
+   in the same pass, so the kernel emits ready-to-hash big-endian messages.
+
+Output: (L, ceil(B*16/128)*128) u32 — slice [:, :B*16] feeds
+ops/sha256_pallas.sha256_words_pallas unchanged.
+
+Re-expresses the chunk-extraction half of the reference's
+DataDeduplicator.java:536-650 (per-chunk array copies feeding the JNI
+hasher) as a TPU DMA program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TL = 8          # lanes per grid step (one (8,128) u32 tile of out sublanes)
+_MAX_LANES = 4096  # per pallas_call: bounds the scalar-prefetch SMEM block
+
+
+def _flat_shift_dynamic(x, p):
+    """Flat left-shift of a (R, 128) window by p words (0 <= p < 128):
+    out_flat[i] = x_flat[i + p].  Lane-axis roll + next-sublane carry.
+    pltpu.roll requires non-negative shifts, so a left roll by p is a
+    right roll by 128 - p (mod the lane count)."""
+    y = pltpu.roll(x, (128 - p) % 128, 1)
+    carry = pltpu.roll(y, x.shape[0] - 1, 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(lane < 128 - p, y, carry)
+
+
+def _flat_shift1(x):
+    """Flat left-shift by exactly one word (static)."""
+    y = pltpu.roll(x, 127, 1)
+    carry = pltpu.roll(y, x.shape[0] - 1, 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(lane < 127, y, carry)
+
+
+def _kernel(ol_ref, hbm_ref, out_ref, scratch, sems, *, rw: int):
+    t = pl.program_id(0)
+
+    def lane_off(i):
+        return ol_ref[0, t * _TL + i]
+
+    for i in range(_TL):
+        r0 = lane_off(i) // (4 * 128)
+        pltpu.make_async_copy(hbm_ref.at[pl.ds(r0, rw)], scratch.at[i],
+                              sems.at[i]).start()
+    for i in range(_TL):
+        r0 = lane_off(i) // (4 * 128)
+        pltpu.make_async_copy(hbm_ref.at[pl.ds(r0, rw)], scratch.at[i],
+                              sems.at[i]).wait()
+        off = lane_off(i)
+        ln = ol_ref[1, t * _TL + i]
+        q = off // 4
+        p = q % 128                       # word phase within the row
+        s8 = ((off % 4) * 8).astype(jnp.uint32)
+
+        a = _flat_shift_dynamic(scratch[i], p)
+        b = _flat_shift1(a)
+        c = jnp.where(s8 == 0, a,
+                      (a << s8) | (b >> (jnp.uint32(32) - s8)))
+
+        # SHA-256 pad splice (same math as resident._bucket_sha, per lane):
+        # keep data words, 0x80 marker at byte ``ln``, zero tail, 64-bit
+        # big-endian bit length in the final word of the last SHA block.
+        wl = ln // 4
+        r8 = ((ln % 4) * 8).astype(jnp.uint32)
+        j = (jax.lax.broadcasted_iota(jnp.int32, a.shape, 0) * 128
+             + jax.lax.broadcasted_iota(jnp.int32, a.shape, 1))
+        keep = jnp.where(r8 == jnp.uint32(0), jnp.uint32(0),
+                         jnp.uint32(0xFFFFFFFF) << (jnp.uint32(32) - r8))
+        marker = jnp.uint32(0x80) << (jnp.uint32(24) - r8)
+        boundary = (c & keep) | marker
+        msg = jnp.where(j < wl, c,
+                        jnp.where(j == wl, boundary, jnp.uint32(0)))
+        nb = (ln + 9 + 63) // 64
+        last = nb * 16 - 1
+        bitlen = (ln * 8).astype(jnp.uint32)
+        msg = jnp.where(j == last, bitlen, msg)
+        out_ref[i] = msg[: out_ref.shape[1]]
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def _gather_chunk(words2d: jax.Array, ol: jax.Array, bucket: int):
+    L = ol.shape[1]
+    w = bucket * 16
+    rw = -(-(w + 128) // 128)             # rows covering W+1 words + phase
+    out_rows = -(-w // 128)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(L // _TL,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((_TL, out_rows, 128),
+                               lambda t, ol_ref: (t, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((_TL, rw, 128), jnp.uint32),
+                        pltpu.SemaphoreType.DMA((_TL,))],
+    )
+    fn = pl.pallas_call(
+        functools.partial(_kernel, rw=rw),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((L, out_rows, 128), jnp.uint32),
+    )
+    return fn(ol, words2d).reshape(L, out_rows * 128)
+
+
+def gather_pad_messages(words: jax.Array, ol: jax.Array,
+                        bucket: int) -> jax.Array:
+    """(L, bucket*16) u32 SHA-ready big-endian messages for one bucket.
+
+    words: u32[NW] resident flat word image, NW % 128 == 0, zero-padded by
+    at least bucket*16 + 160 words past the last addressable offset.
+    ol: i32[2, L] — row 0 byte offsets (within the word image), row 1 chunk
+    byte lengths.  L % 128 == 0.
+    """
+    assert words.shape[0] % 128 == 0, "word image must tile into 128-rows"
+    words2d = words.reshape(-1, 128)
+    L = ol.shape[1]
+    w = bucket * 16
+    if L <= _MAX_LANES:
+        out = _gather_chunk(words2d, ol, bucket)
+    else:
+        parts = [_gather_chunk(words2d, ol[:, i:i + _MAX_LANES], bucket)
+                 for i in range(0, L, _MAX_LANES)]
+        out = jnp.concatenate(parts, axis=0)
+    return out[:, :w]

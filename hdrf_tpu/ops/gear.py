@@ -110,8 +110,11 @@ def pack_bitmap_words(is_cand: jax.Array) -> jax.Array:
     m = is_cand.astype(jnp.float32).reshape(-1, _PACK_ROW)
     packed = jnp.dot(m, jnp.asarray(_pack_weights()),
                      preferred_element_type=jnp.float32)
-    b = packed.astype(jnp.uint32).reshape(-1, 4)
-    return b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
+    # u8 bitcast combine (little-endian), not astype(u32)+strided gather:
+    # the (M, 4) u32 intermediate tiles as minor-dim-4 -> 128 lanes (32x
+    # memory) when XLA materializes it at batch scale.
+    b = packed.astype(jnp.uint8).reshape(-1, 4)
+    return jax.lax.bitcast_convert_type(b, jnp.uint32)
 
 
 @functools.cache

@@ -78,3 +78,38 @@ def test_overlapped_jobs(reducer):
         wc, wd = _oracle(b, reducer.cdc)
         np.testing.assert_array_equal(cuts, wc)
         np.testing.assert_array_equal(digs, wd)
+
+
+def test_reduce_many_batched(reducer):
+    """The batched path (one dispatch + one readback per stage for a group
+    of equal-length blocks) must be bit-identical to the per-block path and
+    the native oracle — including dense-candidate retries and mixed sizes
+    that fall back per block."""
+    rng = np.random.default_rng(7)
+    blocks = [rng.integers(0, 256, size=1 << 19, dtype=np.uint8)
+              for _ in range(3)]
+    odd = rng.integers(0, 256, size=(1 << 19) + 999, dtype=np.uint8)
+    dense = np.zeros(1 << 19, dtype=np.uint8)  # every position a candidate
+    dense2 = dense.copy()
+    inputs = blocks + [odd, dense, dense2, np.empty(0, np.uint8)]
+    results = reducer.reduce_many(inputs)
+    assert len(results) == len(inputs)
+    for data, (cuts, digs) in zip(inputs, results):
+        if data.size == 0:
+            assert cuts.size == 0 and digs.shape == (0, 32)
+            continue
+        wc, wd = _oracle(data, reducer.cdc)
+        np.testing.assert_array_equal(cuts, wc)
+        np.testing.assert_array_equal(digs, wd)
+
+
+def test_batch_lane_count_steps():
+    from hdrf_tpu.ops.resident import _lane_count_geo
+
+    assert _lane_count_geo(1) == 128
+    assert _lane_count_geo(128) == 128
+    assert _lane_count_geo(129) == 256
+    assert _lane_count_geo(1025) == 1152  # step 2048/16=128 above 1024
+    for n in (5475, 43800, 65537, 70000):
+        L = _lane_count_geo(n)
+        assert L >= n and L % 128 == 0 and (L - n) / n <= 0.126
