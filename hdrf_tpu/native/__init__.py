@@ -26,6 +26,7 @@ _lib: ctypes.CDLL | None = None
 _lock = threading.Lock()
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
+_i32p = ctypes.POINTER(ctypes.c_int32)
 _u32p = ctypes.POINTER(ctypes.c_uint32)
 _u64p = ctypes.POINTER(ctypes.c_uint64)
 
@@ -69,6 +70,9 @@ def _load() -> ctypes.CDLL:
         lib.hdrf_lz4_compress.restype = ctypes.c_uint64
         lib.hdrf_lz4_decompress.argtypes = [_u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64]
         lib.hdrf_lz4_decompress.restype = ctypes.c_uint64
+        lib.hdrf_lz4_emit.argtypes = [_u8p, ctypes.c_uint64, _i32p, _u32p,
+                                      ctypes.c_uint64, _u8p, ctypes.c_uint64]
+        lib.hdrf_lz4_emit.restype = ctypes.c_uint64
         lib.hdrf_crc32c.argtypes = [ctypes.c_uint32, _u8p, ctypes.c_uint64]
         lib.hdrf_crc32c.restype = ctypes.c_uint32
         lib.hdrf_crc32c_chunks.argtypes = [_u8p, ctypes.c_uint64, ctypes.c_uint64, _u32p]
@@ -164,6 +168,27 @@ def lz4_compress(data: bytes | np.ndarray) -> bytes:
     n = _load().hdrf_lz4_compress(_ptr(a, _u8p), a.size, _ptr(out, _u8p), cap)
     if n == 0:
         raise RuntimeError("lz4 compression failed")
+    return out[:n].tobytes()
+
+
+def lz4_emit(data: bytes | np.ndarray, positions: np.ndarray,
+             delta_len: np.ndarray) -> bytes:
+    """Greedy-parse + serialize an LZ4 block from externally discovered match
+    records (the host half of the TPU LZ4 path; see hdrf_lz4_emit).  Records
+    are (position, (offset << 16) | est_len), sorted by position."""
+    a = _as_u8(data)
+    if a.size == 0:
+        return b""
+    ps = np.ascontiguousarray(positions, dtype=np.int32)
+    dl = np.ascontiguousarray(delta_len, dtype=np.uint32)
+    if ps.shape != dl.shape:
+        raise ValueError("positions/delta_len shape mismatch")
+    cap = _load().hdrf_lz4_compress_bound(a.size)
+    out = np.empty(cap, dtype=np.uint8)
+    n = _load().hdrf_lz4_emit(_ptr(a, _u8p), a.size, _ptr(ps, _i32p),
+                              _ptr(dl, _u32p), ps.size, _ptr(out, _u8p), cap)
+    if n == 0:
+        raise RuntimeError("lz4 emit failed")
     return out[:n].tobytes()
 
 

@@ -115,6 +115,103 @@ uint64_t hdrf_lz4_compress(const uint8_t *src, uint64_t srclen, uint8_t *dst,
   return uint64_t(op - dst);
 }
 
+// Assemble an LZ4 block from externally discovered match records.
+//
+// This is the host half of the TPU LZ4 path (ops/lz4_tpu.py): the device
+// finds candidate matches (pos, offset, estimated length) with a sorted
+// fingerprint scan; this function runs the greedy parse over those records
+// and serializes standard LZ4 block format.  It re-verifies every record
+// against the source bytes and extends matches exactly (forward and
+// backward), so output correctness never depends on the device results —
+// only the compression ratio does.
+//
+// recs: nrec records sorted by position ascending; pos[i] is the byte
+// position, dl[i] packs (offset << 16) | est_len (offset 1..65535).
+// Returns compressed size, or 0 if dst too small / input empty.
+uint64_t hdrf_lz4_emit(const uint8_t *src, uint64_t srclen, const int32_t *pos,
+                       const uint32_t *dl, uint64_t nrec, uint8_t *dst,
+                       uint64_t dstcap) {
+  if (srclen == 0 || dstcap < hdrf_lz4_compress_bound(srclen)) return 0;
+  const uint8_t *iend = src + srclen;
+  const uint8_t *matchlimit = iend - LAST_LITERALS;
+  const uint8_t *mflimit = srclen > MFLIMIT ? iend - MFLIMIT : src;
+  const uint8_t *anchor = src;
+  uint8_t *op = dst;
+
+  // Lazy parse over the record stream.  The device's estimated lengths
+  // systematically undershoot whenever a nearer duplicate interrupts a
+  // same-delta run (a long periodic match overlaid with RLE), so records are
+  // re-verified and exactly extended here, and at each step ALL records
+  // usable at the cursor (start within LAZY bytes) compete on true extended
+  // end — the record whose match reaches furthest wins.  That recovers the
+  // long structural match when the device's nearest-occurrence rule favored
+  // a short-range RLE reference (measured: 2.6x -> 4x+ on TeraGen rows).
+  constexpr uint64_t LAZY = 3;
+  uint64_t r = 0;
+  while (r < nrec) {
+    uint64_t acur = uint64_t(anchor - src);
+    // Drop records whose verified span (+ slack for under-estimation) is
+    // wholly behind the cursor; keeps the candidate window short.
+    if (uint64_t(pos[r]) + (dl[r] & 0xFFFF) + 64 < acur) { r++; continue; }
+    const uint8_t *base = src + pos[r] > anchor ? src + pos[r] : anchor;
+    if (base >= mflimit) break;
+    const uint8_t *bip = nullptr, *bref = nullptr, *bend = nullptr;
+    for (uint64_t q = r; q < nrec && src + pos[q] <= base + LAZY; q++) {
+      uint32_t off = dl[q] >> 16;
+      if (off == 0) continue;
+      const uint8_t *ip = src + pos[q];
+      if (ip < anchor) ip = anchor;
+      if (ip >= mflimit || uint64_t(ip - src) < off) continue;
+      const uint8_t *ref = ip - off;
+      if (read32(ip) != read32(ref)) continue;  // pad artifact / stale record
+      const uint8_t *mip = ip + MIN_MATCH;
+      const uint8_t *mref = ref + MIN_MATCH;
+      while (mip < matchlimit && *mip == *mref) { mip++; mref++; }
+      while (ip > anchor && ref > src && ip[-1] == ref[-1]) { ip--; ref--; }
+      if (bend == nullptr || mip > bend || (mip == bend && ip < bip)) {
+        bip = ip; bref = ref; bend = mip;
+      }
+    }
+    if (bend == nullptr) { r++; continue; }
+
+    uint64_t matchlen = uint64_t(bend - bip);
+    uint64_t litlen = uint64_t(bip - anchor);
+    uint32_t offset = uint32_t(bip - bref);
+    uint8_t *token = op++;
+    if (litlen >= 15) {
+      *token = 0xF0;
+      op = write_len_ext(op, litlen - 15);
+    } else {
+      *token = uint8_t(litlen << 4);
+    }
+    memcpy(op, anchor, litlen);
+    op += litlen;
+    *op++ = uint8_t(offset);
+    *op++ = uint8_t(offset >> 8);
+    uint64_t mlcode = matchlen - MIN_MATCH;
+    if (mlcode >= 15) {
+      *token |= 0x0F;
+      op = write_len_ext(op, mlcode - 15);
+    } else {
+      *token |= uint8_t(mlcode);
+    }
+    anchor = bend;
+  }
+
+  // Final literals-only sequence.
+  uint64_t litlen = uint64_t(iend - anchor);
+  uint8_t *token = op++;
+  if (litlen >= 15) {
+    *token = 0xF0;
+    op = write_len_ext(op, litlen - 15);
+  } else {
+    *token = uint8_t(litlen << 4);
+  }
+  memcpy(op, anchor, litlen);
+  op += litlen;
+  return uint64_t(op - dst);
+}
+
 // Returns decompressed size, or 0 on malformed input / overflow.
 uint64_t hdrf_lz4_decompress(const uint8_t *src, uint64_t srclen, uint8_t *dst,
                              uint64_t dstcap) {

@@ -15,6 +15,8 @@ interchangeable backends with identical outputs (asserted in tests/test_ops.py):
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from hdrf_tpu.config import CdcConfig
@@ -96,3 +98,27 @@ def chunk_and_fingerprint(data: bytes | np.ndarray, cdc: CdcConfig,
         return r.reduce(data)
     cuts = chunk_cuts(data, cdc, backend)
     return cuts, fingerprints(data, cuts, backend)
+
+
+_tpu_lz4 = None
+_tpu_lz4_lock = threading.Lock()
+
+
+def block_compress(codec: str, data: bytes, backend: str = "native") -> bytes:
+    """Codec dispatch for the entropy stage (container seal / compress-only
+    schemes).  ``lz4`` on the TPU backend runs match discovery on device
+    (ops/lz4_tpu.py — the north star's compression kernel); every other
+    codec/backend pair uses the host codec path.  Output is format-identical
+    either way (standard LZ4 block), so readers never care who compressed."""
+    global _tpu_lz4
+    if codec == "lz4" and backend == "tpu":
+        if _tpu_lz4 is None:
+            with _tpu_lz4_lock:
+                if _tpu_lz4 is None:
+                    from hdrf_tpu.ops.lz4_tpu import TpuLz4
+
+                    _tpu_lz4 = TpuLz4()
+        return _tpu_lz4.compress(data)
+    from hdrf_tpu.utils import codec as codecs
+
+    return codecs.compress(codec, data)

@@ -1,0 +1,359 @@
+"""TPU LZ4 match discovery: the entropy stage of the reduction pipeline.
+
+Re-expresses the reference's container/stream LZ4 compression
+(DataDeduplicator.java:770-781 container rollover; BlockReceiver.java:822-866
+stream codecs) as a device program.  The reference reaches LZ4 through JNI
+(hadoop's native codec); here the expensive half of the encoder — match
+discovery, which on CPU is a serial hash-table walk over every byte — runs on
+TPU, and the cheap half — the greedy/lazy parse + byte serialization, which
+is memcpy-bound — runs in native C++ (``hdrf_lz4_emit``).  This is the same
+device/host split the CDC stage uses (device candidate scan, host cut select).
+
+TPU-native formulation
+----------------------
+An LZ4 encoder needs, for every position p, the most recent previous position
+with the same 4-byte prefix.  A hash table is the CPU answer; **sorting is
+the TPU answer**: within a 128 KiB supertile, sort ``(hash16(w4) << 16) |
+pos/2`` keys — the left neighbor of an entry in sorted order with an equal
+hash is exactly the nearest previous occurrence.  Measured on one v5e chip,
+tiled KV sort runs at ~3 ns/element while per-element gathers and scatters
+(the hash-table formulation) scalarize at 300-600 ns/element — two orders of
+magnitude; every stage here is therefore a dense op, a sort, or a scan, and
+the design avoids gathers entirely:
+
+1. BE u32 word image (shared MXU combine, ops/resident.be_word_image) +
+   sliding 4-gram phases via funnel shifts; entries every ``stride`` bytes.
+2. Per-supertile KV sort of (key=(hash<<16)|pos2, payload=w4); neighbor
+   compare verifies true 4-byte equality (collisions rejected exactly).
+3. A second per-supertile sort un-permutes to position order, where runs of
+   consecutive positions with the same delta — one maximal match — reduce to
+   shifted compares + a reverse-cummin run-length scan, and a cummax
+   frontier keeps only records that advance coverage by >= 4 bytes (the
+   order-free core of the greedy parse; without it, stride-offset chains of
+   overlapping short matches flood ~n/stride records on RLE-ish data).
+4. Gather-free record extraction: a pack sort moves kept records to row
+   prefixes, a transpose rebalances them across rows (record density is
+   wildly skewed — text regions emit 100x more than random regions), and a
+   second small pack sort + static prefix slice yields a bounded readback.
+   Slice widths are jit-shape hints learned from the workload; overflow is
+   detected exactly (total vs returned) and retried wider.
+5. One packed D2H: [total, positions..., (delta<<16|len)...] — O(sequences),
+   the irreducible cost of host-side serialization (the host already holds
+   the literal bytes; in the co-located deployment this is the stored
+   output, smaller than the compressed stream itself).
+
+The native emit re-verifies and exactly extends every record (the device's
+run-based length estimate undershoots when a nearer duplicate interrupts a
+run), choosing among records usable at the cursor by true extended end
+(lazy matching).  **Round-trip correctness is independent of device
+output** — only the ratio depends on it.  Output is standard LZ4 block
+format, decoded by the same ``hdrf_lz4_decompress`` oracle as the CPU path.
+
+Matching differences vs the byte-serial CPU encoder (ratio, not
+correctness): match starts on ``stride``-aligned positions and offsets of
+the same parity (the emit's backward extension recovers most unaligned
+starts), window <= one supertile, sub-``min_len`` matches skipped.  Measured
+ratios: text/zeros/random within 2%, code ~ +12%, TeraGen rows ~ -35% of the
+serial encoder (the nearest-occurrence rule prefers short RLE references
+where the CPU's sparse table insertion accidentally lands longer structural
+matches).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HASH_MUL = np.uint32(2654435761)  # golden-ratio multiplier (lz4.cpp hash4)
+_S = 131072         # supertile span in bytes; window <= LZ4's 65535 anyway
+_E3 = 8192          # L1 pack-sort row width (entries)
+_L2R = 128          # balanced L2 rows
+_BIG = 1 << 30
+_INVALID = np.int32(2**31 - 1)
+
+
+@functools.cache
+def _pos2_row(s4: int) -> np.ndarray:
+    """Entry index -> pos/2 map for stride 2: [0,2,4,..., 1,3,5,...]."""
+    return np.concatenate([2 * np.arange(s4, dtype=np.int32),
+                           2 * np.arange(s4, dtype=np.int32) + 1])
+
+
+def _match_scan_impl(block: jax.Array, stride: int, min_len: int,
+                     p1: int, p2: int):
+    """u8[N] (N % _S == 0) -> packed i32[1 + 2*_L2R*p2] match records.
+
+    Layout: [total_kept, gpos x (_L2R*p2), (delta<<16|len) x (_L2R*p2)];
+    unused slots carry gpos == _INVALID.  total_kept > valid slots means
+    records were dropped by the p1/p2 slices (caller may retry wider; a
+    dropped record only costs ratio, never correctness).
+    """
+    from hdrf_tpu.ops.resident import be_word_image
+
+    n = block.shape[0]
+    t = n // _S
+    s4 = _S // 4
+    w = be_word_image(block)
+    if stride == 4:
+        vals = w.reshape(t, s4)
+        pos_bits = 15
+        posn = jnp.broadcast_to(jnp.arange(s4, dtype=jnp.uint32), (t, s4))
+    elif stride == 2:
+        nxt = jnp.concatenate([w[1:], jnp.zeros(1, jnp.uint32)])
+        mid = (w << 16) | (nxt >> 16)
+        vals = jnp.concatenate([w.reshape(t, s4), mid.reshape(t, s4)], axis=1)
+        pos_bits = 16
+        posn = jnp.broadcast_to(
+            jnp.asarray(_pos2_row(s4), dtype=jnp.uint32), (t, 2 * s4))
+    else:
+        raise ValueError("stride must be 2 or 4")
+
+    h = (vals * _HASH_MUL) >> jnp.uint32(32 - 16)
+    key = (h << jnp.uint32(pos_bits)) | posn
+
+    # Sort 1: group by hash, position-ascending within a group.  The left
+    # neighbor of an entry in sorted order with an equal hash is the nearest
+    # previous occurrence; the payload carries the 4-gram itself so equality
+    # is verified exactly on device.  (Without it, ~half the entries in a
+    # 2^16-hash row have a same-bucket predecessor by chance and
+    # incompressible data floods false records.)
+    sk, sv = jax.lax.sort((key, vals), dimension=1, num_keys=1)
+    pk = jnp.concatenate([jnp.full((t, 1), 0xFFFFFFFF, jnp.uint32),
+                          sk[:, :-1]], axis=1)
+    pv = jnp.concatenate([jnp.zeros((t, 1), jnp.uint32), sv[:, :-1]], axis=1)
+    same = (sk >> jnp.uint32(pos_bits)) == (pk >> jnp.uint32(pos_bits))
+    okm = same & (sv == pv)
+    pmask = jnp.uint32((1 << pos_bits) - 1)
+    delta = jnp.where(okm, ((sk & pmask) - (pk & pmask)) * jnp.uint32(stride),
+                      jnp.uint32(0))
+    # Nearest predecessor beyond the LZ4 offset limit -> no usable match
+    # (any farther occurrence is farther still).
+    delta = jnp.where(delta <= jnp.uint32(65535), delta, jnp.uint32(0))
+
+    # Sort 2: un-permute to position order (pos keys are unique per row), so
+    # entry i of a row is byte position stride*i and same-delta runs are
+    # neighbor relations.
+    _, d = jax.lax.sort((sk & pmask, delta), dimension=1, num_keys=1)
+
+    okp = d > 0
+    pd = jnp.concatenate([jnp.zeros((t, 1), jnp.uint32), d[:, :-1]], axis=1)
+    cont = okp & (d == pd)
+    start = okp & ~cont
+
+    # Run length: distance to the next entry that breaks the run, via a
+    # reverse cummin over (index where not-continuing, +inf elsewhere).
+    e = d.shape[1]
+    iota = jnp.broadcast_to(jnp.arange(e, dtype=jnp.int32), (t, e))
+    pos_b = iota * stride
+    brk = jnp.where(cont, _BIG, iota)
+    nxt_brk = jax.lax.cummin(brk, axis=1, reverse=True)
+    nxt1 = jnp.concatenate([nxt_brk[:, 1:], jnp.full((t, 1), e, jnp.int32)],
+                           axis=1)
+    run_entries = jnp.minimum(nxt1, e) - iota            # valid at starts
+    mlen = (run_entries - 1) * stride + 4
+
+    keep0 = start & (mlen >= min_len)
+    # Frontier-advance filter: an order-free approximation of the greedy
+    # parse.  The frontier is the furthest verified end so far; a record is
+    # useful only if it reaches >= 4 bytes past it (enough for a legal match
+    # tail after the parse consumes to the frontier).  A plain `end >
+    # frontier` keeps stride-offset chains of overlapping short matches,
+    # each advancing by `stride`; a `start >= frontier` cursor rule
+    # over-suppresses (tail-extension records are what the parse uses —
+    # dropping them measured ~30-90% ratio loss on text/code).
+    end = pos_b + mlen
+    fr = jax.lax.cummax(jnp.where(keep0, end, 0), axis=1)
+    fr_before = jnp.concatenate([jnp.zeros((t, 1), jnp.int32), fr[:, :-1]],
+                                axis=1)
+    keep = keep0 & (end >= fr_before + 4)
+
+    gpos = pos_b + jnp.arange(t, dtype=jnp.int32)[:, None] * _S
+    rec = (d << jnp.uint32(16)) | jnp.minimum(mlen, 65535).astype(jnp.uint32)
+    rec = jax.lax.bitcast_convert_type(rec, jnp.int32)
+    total = jnp.sum(keep.astype(jnp.int32))
+
+    # Gather-free extraction (TPU gathers scalarize at ~0.3-0.6 us/element;
+    # a jnp.nonzero + take compaction measured ~0.7 s per 64 MiB — more than
+    # the two KV sorts above combined).  Pack sort L1 moves kept records to
+    # row prefixes; a transpose deals rows round-robin so the wildly skewed
+    # record density (text supertiles emit 100x more than random ones)
+    # balances before the L2 pack + static prefix slice.
+    t3 = gpos.size // _E3
+    l_iota = jnp.broadcast_to(jnp.arange(_E3, dtype=jnp.int32), (t3, _E3))
+    k3 = jnp.where(keep.reshape(t3, _E3), l_iota, jnp.int32(_E3))
+    g3 = jnp.where(keep.reshape(t3, _E3), gpos.reshape(t3, _E3), _INVALID)
+    _, g1, r1 = jax.lax.sort((k3, g3, rec.reshape(t3, _E3)),
+                             dimension=1, num_keys=1)
+    g1, r1 = g1[:, :p1], r1[:, :p1]                      # L1 prefix slice
+    e2 = p1 * t3 // _L2R
+    g2 = g1.T.reshape(_L2R, e2)
+    r2 = r1.T.reshape(_L2R, e2)
+    i2 = jnp.broadcast_to(jnp.arange(e2, dtype=jnp.int32), (_L2R, e2))
+    k2 = jnp.where(g2 != _INVALID, i2, jnp.int32(e2))
+    _, go, ro = jax.lax.sort((k2, g2, r2), dimension=1, num_keys=1)
+    go, ro = go[:, :p2], ro[:, :p2]                      # L2 prefix slice
+    return jnp.concatenate([total[None], go.reshape(-1), ro.reshape(-1)])
+
+
+_match_scan = functools.partial(
+    jax.jit, static_argnames=("stride", "min_len", "p1", "p2"))(
+        _match_scan_impl)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("stride", "min_len", "p1", "p2"))
+def _match_scan_batch(blocks: jax.Array, stride: int, min_len: int,
+                      p1: int, p2: int):
+    """K equal-length blocks in ONE device program (one dispatch, one packed
+    readback for the group) — same batching rationale as _prep_batch."""
+    return jnp.stack([_match_scan_impl(blocks[k], stride, min_len, p1, p2)
+                      for k in range(blocks.shape[0])])
+
+
+@dataclasses.dataclass
+class Lz4Job:
+    n: int                     # true byte length
+    host: np.ndarray           # host copy for emit/fallback
+    block: jax.Array | None    # resident padded u8 (kept for overflow retry)
+    recs: jax.Array | None     # packed records, D2H in flight
+    p1: int = 0
+    p2: int = 0
+
+
+class TpuLz4:
+    """Async LZ4 front end over the device match scan.
+
+    Usage (overlapped): ``jobs = [c.submit(b) for b in bufs]`` then
+    ``[c.finish(j) for j in jobs]`` — readbacks of job k hide under the
+    dispatches of k+1.  ``compress`` is the synchronous convenience.  Inputs
+    smaller than ``min_device`` bytes take the native path (device overhead
+    beats the win below a couple of supertiles).
+    """
+
+    def __init__(self, stride: int = 2, min_len: int = 4,
+                 min_device: int = 2 * _S):
+        assert stride in (2, 4)
+        self.stride = stride
+        self.min_len = min_len
+        self.min_device = min_device
+        # Slice widths are jit-cache keys; blocks in one stream compress
+        # alike, so sizes learned from overflow retries stick.  The lock
+        # covers the hint state: concurrent seals (DataNode container lanes)
+        # share one instance.
+        self._p1 = 512
+        self._p2 = 4096
+        self._lock = threading.Lock()
+
+    def _pad(self, a: np.ndarray) -> np.ndarray:
+        pad = (-a.size) % _S
+        return np.concatenate([a, np.zeros(pad, np.uint8)]) if pad else a
+
+    def _shapes(self, n_pad: int) -> tuple[int, int]:
+        entries = n_pad // self.stride
+        t3 = entries // _E3
+        p1 = min(self._p1, _E3)
+        while p1 * t3 % _L2R:
+            p1 *= 2
+        p2 = min(self._p2, p1 * t3 // _L2R)
+        return p1, p2
+
+    def submit(self, data: bytes | np.ndarray,
+               device_image: jax.Array | None = None) -> Lz4Job:
+        """``device_image`` (padded u8, length % _S == 0) skips the host->
+        device upload when the bytes are already HBM-resident — the
+        co-located TPU-worker deployment, where container payloads were
+        staged during reduction (and the bench's service-rate framing)."""
+        a = (np.frombuffer(data, dtype=np.uint8)
+             if not isinstance(data, np.ndarray) else data)
+        if a.size < self.min_device:
+            return Lz4Job(n=a.size, host=a, block=None, recs=None)
+        if device_image is not None:
+            assert device_image.shape[0] % _S == 0
+            block = device_image
+        else:
+            block = jax.device_put(self._pad(a))
+        p1, p2 = self._shapes(block.shape[0])
+        recs = _match_scan(block, self.stride, self.min_len, p1, p2)
+        recs.copy_to_host_async()
+        return Lz4Job(n=a.size, host=a, block=block, recs=recs, p1=p1, p2=p2)
+
+    def _unpack(self, rec_row: np.ndarray, p2: int):
+        total = int(rec_row[0])
+        g = rec_row[1:1 + _L2R * p2]
+        r = rec_row[1 + _L2R * p2:]
+        m = g != _INVALID
+        g, r = g[m], r[m]
+        order = np.argsort(g, kind="stable")
+        return total, g[order], r[order].view(np.uint32)
+
+    def _assemble(self, job: Lz4Job, rec_row: np.ndarray) -> bytes:
+        from hdrf_tpu import native
+
+        total, g, r = self._unpack(rec_row, job.p2)
+        # Slice overflow dropped records: rescan at the current (possibly
+        # already-widened-by-a-peer-job) shape hints, widening further
+        # (sticky) while records still don't fit.
+        while total > g.size and job.block is not None:
+            with self._lock:
+                p1, p2 = self._shapes(job.block.shape[0])
+                if (p1, p2) == (job.p1, job.p2):
+                    if self._p2 < job.block.shape[0] // self.stride // _L2R:
+                        self._p2 *= 2
+                    elif self._p1 < _E3:
+                        self._p1 *= 2
+                    else:
+                        break
+                    p1, p2 = self._shapes(job.block.shape[0])
+            rec_row = np.asarray(_match_scan(
+                job.block, self.stride, self.min_len, p1, p2))
+            job.p1, job.p2 = p1, p2
+            total, g, r = self._unpack(rec_row, p2)
+        m = g < max(job.n - 12, 0)    # spec MFLIMIT; drops pad-region hits
+        return native.lz4_emit(job.host, g[m], r[m])
+
+    def finish(self, job: Lz4Job) -> bytes:
+        from hdrf_tpu import native
+
+        if job.recs is None:
+            return native.lz4_compress(job.host) if job.n else b""
+        out = self._assemble(job, np.asarray(job.recs))
+        job.block = None
+        job.recs = None
+        return out
+
+    def compress(self, data: bytes | np.ndarray) -> bytes:
+        return self.finish(self.submit(data))
+
+    # ------------------------------------------------------- batched groups
+
+    def submit_many(self, datas: list):
+        """Equal-length blocks run as one device program with one grouped
+        readback; mixed lengths fall back to per-buffer submits."""
+        arrs = [np.frombuffer(d, dtype=np.uint8)
+                if not isinstance(d, np.ndarray) else d for d in datas]
+        sizes = {a.size for a in arrs}
+        if len(sizes) != 1 or arrs[0].size < self.min_device or len(arrs) == 1:
+            return [self.submit(a) for a in arrs]
+        n = arrs[0].size
+        stacked = np.stack([self._pad(a) for a in arrs])
+        blocks = jax.device_put(stacked)
+        p1, p2 = self._shapes(stacked.shape[1])
+        recs = _match_scan_batch(blocks, self.stride, self.min_len, p1, p2)
+        recs.copy_to_host_async()
+        return ([Lz4Job(n=n, host=a, block=blocks[k], recs=None, p1=p1, p2=p2)
+                 for k, a in enumerate(arrs)], recs)
+
+    def finish_many(self, submitted) -> list[bytes]:
+        if isinstance(submitted, list):  # per-buffer fallback shape
+            return [self.finish(j) for j in submitted]
+        jobs, recs = submitted
+        rows = np.asarray(recs)
+        return [self._assemble(j, rows[k]) for k, j in enumerate(jobs)]
+
+    def compress_many(self, datas: list) -> list[bytes]:
+        return self.finish_many(self.submit_many(datas))

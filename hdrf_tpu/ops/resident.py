@@ -85,25 +85,30 @@ def _combine_weights(byte0: int) -> "np.ndarray":
     return w
 
 
+def be_word_image(block: jax.Array) -> jax.Array:
+    """u8[N] -> big-endian u32[N/4] word image, via MXU block-diagonal
+    combines.  Neither astype(u32) on a (N/4, 4) view nor a u8->u32 bitcast
+    works at speed here: both make XLA materialize a 32x-padded minor-dim-4
+    intermediate (measured 27 ms per 64 MiB — the dominant _prep cost).  Two
+    matmuls build the 16-bit halves exactly in f32 (values <= 2^16-1 < 2^24),
+    then one integer shift-or fuses them: pure bandwidth + trivial MXU work.
+    Shared by the CDC prep pass and the LZ4 match scan (ops/lz4_tpu.py)."""
+    bf = block.astype(jnp.float32).reshape(-1, _COMBINE_ROW)
+    hi = jnp.dot(bf, jnp.asarray(_combine_weights(0)),
+                 preferred_element_type=jnp.float32)
+    lo = jnp.dot(bf, jnp.asarray(_combine_weights(2)),
+                 preferred_element_type=jnp.float32)
+    return ((hi.astype(jnp.uint32) << 16)
+            | lo.astype(jnp.uint32)).reshape(-1)
+
+
 def _prep_impl(block: jax.Array, mask: int, cap: int, pad_words: int):
     """One pass over the resident block: BE word image + candidate scan.
 
     Returns (words u32[N/4 + pad_words], cand i32[1 + 2*cap]) where cand
     packs [count, word_idx..., word_val...] into a single D2H transfer.
     """
-    # BE word image via MXU block-diagonal combines.  Neither astype(u32)
-    # on a (N/4, 4) view nor a u8->u32 bitcast works at speed here: both
-    # make XLA materialize a 32x-padded minor-dim-4 intermediate (measured
-    # 27 ms per 64 MiB — the dominant _prep cost).  Two matmuls build the
-    # 16-bit halves exactly in f32 (values <= 2^16-1 < 2^24), then one
-    # integer shift-or fuses them: pure bandwidth + trivial MXU work.
-    bf = block.astype(jnp.float32).reshape(-1, _COMBINE_ROW)
-    hi = jnp.dot(bf, jnp.asarray(_combine_weights(0)),
-                 preferred_element_type=jnp.float32)
-    lo = jnp.dot(bf, jnp.asarray(_combine_weights(2)),
-                 preferred_element_type=jnp.float32)
-    words = ((hi.astype(jnp.uint32) << 16)
-             | lo.astype(jnp.uint32)).reshape(-1)
+    words = be_word_image(block)
     words = jnp.concatenate([words, jnp.zeros(pad_words, jnp.uint32)])
 
     cw = gear.candidate_bitmap_words(block, jnp.uint32(mask))

@@ -37,6 +37,38 @@ from hdrf_tpu.utils import metrics, tracing
 _M = metrics.registry("dedup")
 
 
+def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
+                 digests: np.ndarray, index, containers) -> tuple[int, int]:
+    """The host half of the write pipeline, given device/native reduction
+    results: ordered hash list, first-occurrence ranges, index lookup,
+    container append of unique bytes, single-record index commit
+    (DataDeduplicator.java checkChunk :338-367 + storeChunksMT :511-532 +
+    storeDB :372-392).  Shared by DedupScheme.reduce and the full-path
+    benchmark so the timed path IS the product path.  Returns
+    (chunk_count, new_unique_count)."""
+    mv = data.tobytes() if isinstance(data, np.ndarray) else data
+    starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
+    n = len(cuts)
+    hashes: list[bytes] = []
+    first_range: dict[bytes, tuple[int, int]] = {}
+    for i in range(n):
+        h = digests[i].tobytes()
+        hashes.append(h)
+        if h not in first_range:
+            first_range[h] = (int(starts[i]), int(cuts[i] - starts[i]))
+    known = index.lookup_chunks(list(first_range))
+    new_hashes = [h for h, loc in known.items() if loc is None]
+    chunk_bytes = [mv[o:o + ln] for o, ln in
+                   (first_range[h] for h in new_hashes)]
+    locs = containers.append_chunks(chunk_bytes, on_seal=index.seal_container)
+    index.commit_block(block_id, len(data), hashes,
+                       dict(zip(new_hashes, locs)))
+    _M.incr("chunks_total", n)
+    _M.incr("chunks_new", len(new_hashes))
+    _M.incr("bytes_new", sum(ln for _, _, ln in locs))
+    return n, len(new_hashes)
+
+
 class DedupScheme(ReductionScheme):
     """CDC dedup; ``container_codec`` tells the DataNode how to build its
     ContainerStore (the rollover compression stage — reference mode 1 rolls
@@ -55,35 +87,12 @@ class DedupScheme(ReductionScheme):
             buf = np.frombuffer(data, dtype=np.uint8)
             cuts, digests = dispatch.chunk_and_fingerprint(
                 buf, ctx.config.cdc, ctx.backend)
-            starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
-            n = len(cuts)
-
-            # Ordered fingerprint list + first-occurrence ranges.
-            hashes: list[bytes] = []
-            first_range: dict[bytes, tuple[int, int]] = {}
-            for i in range(n):
-                h = digests[i].tobytes()
-                hashes.append(h)
-                if h not in first_range:
-                    first_range[h] = (int(starts[i]), int(cuts[i] - starts[i]))
-
-            known = ctx.index.lookup_chunks(list(first_range))
-            new_hashes = [h for h, loc in known.items() if loc is None]
-            chunk_bytes = [data[o:o + ln] for o, ln in
-                           (first_range[h] for h in new_hashes)]
-            locs = ctx.containers.append_chunks(
-                chunk_bytes, on_seal=ctx.index.seal_container)
-            new_chunks = dict(zip(new_hashes, locs))
-            ctx.index.commit_block(block_id, len(data), hashes, new_chunks)
-
-            new_bytes = sum(ln for _, _, ln in locs)
+            n, new = dedup_commit(block_id, data, cuts, digests,
+                                  ctx.index, ctx.containers)
             sp.annotate("chunks", n)
-            sp.annotate("unique_new", len(new_hashes))
+            sp.annotate("unique_new", new)
             _M.incr("blocks_reduced")
-            _M.incr("chunks_total", n)
-            _M.incr("chunks_new", len(new_hashes))
             _M.incr("bytes_logical", len(data))
-            _M.incr("bytes_new", new_bytes)
         return b""  # replica data file stays empty by design
 
     # ---------------------------------------------------------------- read

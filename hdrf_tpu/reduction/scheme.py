@@ -124,7 +124,9 @@ class CompressScheme(ReductionScheme):
         self._codec = codec
 
     def reduce(self, block_id: int, data: bytes, ctx: ReductionContext) -> bytes:
-        return codecs.compress(self._codec, data)
+        from hdrf_tpu.ops import dispatch
+
+        return dispatch.block_compress(self._codec, data, ctx.backend)
 
     def reconstruct(self, block_id: int, stored: bytes, logical_len: int,
                     ctx: ReductionContext, offset: int = 0,

@@ -65,13 +65,21 @@ class DataNode:
         else:
             self.replicas = ReplicaStore(
                 os.path.join(config.data_dir, "replicas"))
+        backend = ops_dispatch.resolve_backend(red.backend)
+        # On the TPU backend the container seal's entropy stage (the
+        # reference's rollover LZ4, DataDeduplicator.java:770-781) runs its
+        # match discovery on device; output stays stock LZ4 block format.
+        seal_fn = (
+            (lambda data: ops_dispatch.block_compress("lz4", data, "tpu"))
+            if backend == "tpu" and red.container_codec == "lz4" else None)
         self.containers = ContainerStore(
             os.path.join(config.data_dir, "containers"),
-            container_size=red.container_size, codec=red.container_codec)
+            container_size=red.container_size, codec=red.container_codec,
+            compress_fn=seal_fn)
         self.index = ChunkIndex(os.path.join(config.data_dir, "index"))
         self.reduction_ctx = ReductionContext(
             config=red, containers=self.containers, index=self.index,
-            backend=ops_dispatch.resolve_backend(red.backend))
+            backend=backend)
         # Admission control: bounded slots instead of ticket queues.
         self._write_sem = threading.Semaphore(red.max_concurrent_writes)
         self._read_sem = threading.Semaphore(red.max_concurrent_reads)
