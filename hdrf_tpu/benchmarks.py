@@ -43,24 +43,45 @@ def bench_nn(args) -> None:
         for i in range(n):
             nn.rpc_mkdir(f"/bench/dir{i % 100}/sub{i}")
         print(json.dumps({"op": "mkdir", "ops_per_s": round(_rate(n, t0))}))
+        # Create chains from CONCURRENT clients — the NameNode's real load
+        # shape, and what the edit log's group commit batches: handlers
+        # buffer under the namesystem lock and one fsync covers every
+        # concurrent handler's records (FSEditLog.logSync design).
+        import threading
+
+        workers = 16
+        per = n // workers
+
+        def chain(w: int) -> None:
+            for i in range(per):
+                p = f"/bench/f{w}_{i}"
+                nn.rpc_create(p, client=f"b{w}")
+                if w == 0 and i % 50 == 0:
+                    nn.rpc_heartbeat("dn-bench")  # keep the DN alive
+                alloc = nn.rpc_add_block(p, client=f"b{w}")
+                nn.rpc_complete(p, client=f"b{w}",
+                                block_lengths={alloc["block_id"]: 1024})
         t0 = time.perf_counter()
-        for i in range(n):
-            nn.rpc_create(f"/bench/f{i}", client="b")
-            nn.rpc_heartbeat("dn-bench")
-            alloc = nn.rpc_add_block(f"/bench/f{i}", client="b")
-            nn.rpc_complete(f"/bench/f{i}", client="b",
-                            block_lengths={alloc["block_id"]: 1024})
+        ts = [threading.Thread(target=chain, args=(w,)) for w in range(workers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
         print(json.dumps({"op": "create+addBlock+complete",
-                          "ops_per_s": round(_rate(n, t0))}))
+                          "clients": workers,
+                          "ops_per_s": round(_rate(per * workers, t0))}))
+        names = [f"/bench/f{w}_{i}" for w in range(workers)
+                 for i in range(per)]
         t0 = time.perf_counter()
-        for i in range(n):
-            nn.rpc_get_block_locations(f"/bench/f{i}")
+        for p in names:
+            nn.rpc_get_block_locations(p)
         print(json.dumps({"op": "getBlockLocations",
-                          "ops_per_s": round(_rate(n, t0))}))
+                          "ops_per_s": round(_rate(len(names), t0))}))
         t0 = time.perf_counter()
-        for i in range(n):
-            nn.rpc_delete(f"/bench/f{i}")
-        print(json.dumps({"op": "delete", "ops_per_s": round(_rate(n, t0))}))
+        for p in names:
+            nn.rpc_delete(p)
+        print(json.dumps({"op": "delete",
+                          "ops_per_s": round(_rate(len(names), t0))}))
         nn._editlog.close()
 
 

@@ -97,6 +97,15 @@ class NameNodeConfig:
     # Startup safemode: hold mutations until this fraction of known blocks
     # has a reported replica (dfs.namenode.safemode.threshold-pct analog).
     safemode_threshold: float = 0.999
+    # Quorum journal (dfs.namenode.shared.edits.dir=qjournal://... analog):
+    # when set, edits live on this list of JournalNode (host, port) addrs
+    # with majority-ack durability and only the fsimage stays in meta_dir;
+    # when None, meta_dir is the (possibly NFS-shared) journal directory.
+    journal_addrs: list | None = None
+    # Peer NameNode control addrs — a quorum-mode standby that fell behind
+    # the journal's purge horizon bootstraps its fsimage from a peer
+    # (the standby-checkpointer image-transfer analog).
+    peers: list | None = None
 
 
 @dataclass

@@ -7,68 +7,68 @@ snapshot; fsimage.proto).  Same durability discipline as the chunk index
 a crash between image publish and WAL truncation cannot double-apply, torn
 tails dropped via CRC framing (utils/wal.py).
 
-Checkpointing here is in-process (the SecondaryNameNode / StandbyCheckpointer
-roles collapse into one daemon; HA-style shared edits are out of scope for a
-single-NN deployment).
+Two pieces compose here:
+
+- a **journal backend** (server/journal.py): either the flock-fenced shared
+  directory (``LocalJournal``) or the JournalNode quorum (``QuorumJournal``,
+  the qjournal re-expression) — selected by ``journal_addrs``.
+- **group commit** (the reference's ``FSEditLog.logSync`` design,
+  FSEditLog.java:124): mutations buffer under the namesystem lock via
+  ``append_async`` and become durable in batches via ``sync`` — the first
+  thread to need durability becomes the sync leader and flushes everyone's
+  buffered records with ONE backend append (one fsync locally / one quorum
+  round), while followers wait on the condition.  Callers that cannot
+  tolerate the restructure use ``append`` (= append_async + sync).
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable
 
 import msgpack
 
-from hdrf_tpu.utils import fault_injection, wal as walmod
+from hdrf_tpu.server.journal import (  # noqa: F401  (re-exported API)
+    FencedError, JournalGapError, LocalJournal, QuorumJournal,
+    QuorumLostError)
+from hdrf_tpu.utils import fault_injection
 
-WAL_NAME = "edits.wal"
 IMG_NAME = "fsimage"
 IMG_TMP = "fsimage.tmp"
-EPOCH_NAME = "epoch"
-
-
-class FencedError(Exception):
-    """This NameNode's epoch is stale: another NN has transitioned to active
-    (the QJM epoch-fencing analog — writers with an old epoch are rejected)."""
 
 
 class EditLog:
-    def __init__(self, directory: str, checkpoint_every: int = 1000):
+    def __init__(self, directory: str, checkpoint_every: int = 1000,
+                 journal_addrs: list | None = None):
+        """``directory`` holds the fsimage (and, without ``journal_addrs``,
+        the shared journal); with ``journal_addrs`` the edits live on that
+        JournalNode quorum and only the image is local."""
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
-        self.seq = 0  # last seqno applied (image seq after load)
+        self.seq = 0            # last seqno applied (image seq after load)
         self._ops_since_ckpt = 0
         self._checkpoint_every = checkpoint_every
         self._snapshot_fn: Callable[[], Any] | None = None
-        self._wal = None  # opened after recovery
-        self._epoch: int | None = None  # writer epoch once active
-        self._lock_f = None
-        self._epoch_cache: int | None = None
-        self._epoch_sig = ()
+        self.journal = (QuorumJournal(journal_addrs) if journal_addrs
+                        else LocalJournal(directory))
+        self._appendable = False
+        # group-commit state
+        self._buf_lock = threading.Lock()
+        self._sync_cond = threading.Condition()
+        self._buffered: list[bytes] = []
+        self._buf_first_seq = 0   # seq of _buffered[0]
+        self._buffered_seq = 0    # last buffered seq
+        self._durable_seq = 0
+        self._syncing = False
 
     # ----------------------------------------------------------- HA fencing
 
     def read_epoch(self) -> int:
-        try:
-            with open(os.path.join(self._dir, EPOCH_NAME)) as f:
-                return int(f.read().strip() or 0)
-        except FileNotFoundError:
-            return 0
+        return self.journal.read_epoch()
 
     def claim_epoch(self) -> int:
-        """Become the writer: bump the shared epoch under the journal lock
-        (serialized against in-flight appends); any previous writer's next
-        append sees the newer epoch and gets FencedError."""
-        with self._fence_lock():
-            e = self.read_epoch() + 1
-            tmp = os.path.join(self._dir, EPOCH_NAME + ".tmp")
-            with open(tmp, "w") as f:
-                f.write(str(e))
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, os.path.join(self._dir, EPOCH_NAME))
-        self._epoch = e
-        return e
+        return self.journal.claim_epoch()
 
     # -------------------------------------------------------------- recovery
 
@@ -85,36 +85,37 @@ class EditLog:
 
     def replay(self, apply_fn: Callable[[list], None],
                readonly: bool = False) -> int:
-        """Replay WAL records newer than the image; returns count applied.
-        Call once, after load_image, before open_for_append.  recover()
-        truncates any torn tail so open_for_append continues at the good
-        prefix (appending behind garbage would lose acked edits); a standby
-        tailer passes ``readonly`` — it must never truncate the active's WAL
-        mid-append (the tail it sees as torn may still be in flight)."""
+        """Replay journal records newer than the image; returns count
+        applied.  Call once, after load_image, before open_for_append.  The
+        writer path (``readonly=False``) also truncates a torn local tail so
+        appends continue at the good prefix; a standby tailer passes
+        ``readonly`` — it must never truncate the active's journal and never
+        applies past the quorum's committed floor."""
         n = 0
-        for payload in walmod.recover(os.path.join(self._dir, WAL_NAME),
-                                      truncate=not readonly):
+        for payload in self.journal.read(self.seq, readonly=readonly):
             seq, *rec = msgpack.unpackb(payload, raw=False, use_list=True,
                                         strict_map_key=False)
             if seq > self.seq:
                 apply_fn(rec)
                 self.seq = seq
                 n += 1
+        if not readonly:
+            self._durable_seq = self._buffered_seq = self.seq
+            self._buf_first_seq = self.seq + 1
         return n
 
     def tail(self, apply_fn: Callable[[list], None],
              reload_fn: Callable[[Any], None] | None = None,
              readonly: bool = True) -> int:
         """Standby-side incremental catch-up (EditLogTailer.java:74 analog):
-        if the active has published a newer fsimage (its checkpoint truncated
-        the WAL), reload it first, then apply WAL records past ``seq``.
+        if a newer fsimage is visible locally (shared-dir deployments: the
+        active's checkpoint truncated the journal), reload it first, then
+        apply records past ``seq``.
 
-        A standby tails ``readonly`` (the torn tail it sees may be the
-        active's write in flight).  The final catch-up during promotion must
-        pass ``readonly=False``: the caller has claimed the epoch and is the
-        sole journal writer, and appending behind a torn frame would make
-        every subsequently acked edit unreachable to replay (wal.scan stops
-        at the first corrupt frame) — silent namespace loss on restart."""
+        The final catch-up during promotion passes ``readonly=False``: the
+        caller has claimed the epoch and is the sole journal writer (local
+        mode additionally truncates a torn tail, without which every
+        subsequently acked edit would be unreachable to replay)."""
         img = os.path.join(self._dir, IMG_NAME)
         if os.path.exists(img) and reload_fn is not None:
             with open(img, "rb") as f:
@@ -126,97 +127,124 @@ class EditLog:
         return self.replay(apply_fn, readonly=readonly)
 
     def open_for_append(self, snapshot_fn: Callable[[], Any]) -> None:
-        """``snapshot_fn`` is called at auto-checkpoint time to capture the
+        """``snapshot_fn`` is called at checkpoint time to capture the
         current namespace state."""
         self._snapshot_fn = snapshot_fn
-        self._wal = open(os.path.join(self._dir, WAL_NAME), "ab")
+        self.journal.open_for_append()
+        self._appendable = True
+        self._durable_seq = self._buffered_seq = self.seq
+        self._buf_first_seq = self.seq + 1
 
     # --------------------------------------------------------------- logging
 
-    def _fence_lock(self):
-        """An flock'd context on the shared lock file (persistent handle: the
-        append hot path must not pay open/close per op).  Held across
-        epoch-check + WAL write so a concurrent claim_epoch (which takes the
-        same lock) cannot interleave — without it a fenced writer could slip
-        one record into the journal between its check and its write, and its
-        seq would collide with the new active's next acked edit."""
-        import contextlib
-        import fcntl
+    def append_async(self, rec: list) -> int:
+        """Assign the next seqno and buffer the record; durable only after
+        ``sync`` covers the returned seq.  Called under the namesystem lock;
+        does NOT touch the journal (that's the whole point: the fsync leaves
+        the lock hold time)."""
+        fault_injection.point("editlog.append")
+        self.journal.check_fence()  # cheap (stat-cached locally; no-op quorum)
+        with self._buf_lock:
+            seq = self._buffered_seq + 1
+            self._buffered.append(msgpack.packb([seq, *rec]))
+            self._buffered_seq = seq
+        self.seq = seq
+        self._ops_since_ckpt += 1
+        return seq
 
-        if self._lock_f is None or self._lock_f.closed:
-            self._lock_f = open(os.path.join(self._dir, "journal.lock"), "a+")
-
-        @contextlib.contextmanager
-        def held():
-            fcntl.flock(self._lock_f.fileno(), fcntl.LOCK_EX)
+    def sync(self, seq: int) -> None:
+        """Group commit (logSync): wait until records <= seq are durable.
+        The first waiter becomes the leader and appends the WHOLE buffer as
+        one backend batch; concurrent waiters ride the same fsync/quorum
+        round.  Raises FencedError/QuorumLostError if durability cannot be
+        promised — the caller must stop acking and demote."""
+        while True:
+            with self._sync_cond:
+                if self._durable_seq >= seq:
+                    return
+                if seq > self._buffered_seq:
+                    # This instance never buffered `seq` — the caller holds
+                    # a pending seq from a PREVIOUS editlog (demotion swap).
+                    # Without this check the leader round below would find
+                    # an empty buffer and spin forever.
+                    raise FencedError(
+                        f"seq {seq} was never buffered here (demoted?)")
+                if self._syncing:
+                    self._sync_cond.wait(timeout=30)
+                    continue
+                self._syncing = True
             try:
-                yield
+                with self._buf_lock:
+                    batch = self._buffered
+                    first = self._buf_first_seq
+                    last = self._buffered_seq
+                    self._buffered = []
+                    self._buf_first_seq = last + 1
+                if batch:
+                    try:
+                        self.journal.append_frames(batch, first)
+                    except Exception:
+                        # Not durable: put the batch back so a retry (or a
+                        # later leader) still covers these seqs in order.
+                        with self._buf_lock:
+                            self._buffered = batch + self._buffered
+                            self._buf_first_seq = first
+                        raise
+                with self._sync_cond:
+                    self._durable_seq = max(self._durable_seq, last)
             finally:
-                fcntl.flock(self._lock_f.fileno(), fcntl.LOCK_UN)
-
-        return held()
-
-    def _check_fence(self) -> None:
-        """Raise FencedError iff another writer claimed a newer epoch.  The
-        epoch value is cached against the file's stat signature so the hot
-        path pays one stat, not an open+read."""
-        if self._epoch is None:
-            return
-        path = os.path.join(self._dir, EPOCH_NAME)
-        try:
-            st = os.stat(path)
-            sig = (st.st_mtime_ns, st.st_ino)
-        except FileNotFoundError:
-            sig = None
-        if sig != self._epoch_sig:
-            self._epoch_cache = self.read_epoch()
-            self._epoch_sig = sig
-        if self._epoch_cache != self._epoch:
-            raise FencedError(
-                f"epoch {self._epoch} superseded by {self._epoch_cache}")
+                with self._sync_cond:
+                    self._syncing = False
+                    self._sync_cond.notify_all()
 
     def append(self, rec: list) -> None:
-        """Durably log one mutation (logSync analog — every record is fsync'd;
-        the reference's group commit batching is future work)."""
-        payload = msgpack.packb([self.seq + 1, *rec])
-        fault_injection.point("editlog.append")
-        with self._fence_lock():
-            self._check_fence()
-            self._wal.write(walmod.frame(payload))
-            self._wal.flush()
-            os.fsync(self._wal.fileno())
-        self.seq += 1
-        self._ops_since_ckpt += 1
-        if self._ops_since_ckpt >= self._checkpoint_every:
-            self.checkpoint()
+        """Durably log one mutation (append_async + sync — the non-batched
+        compatibility path for callers outside the RPC fast path)."""
+        self.sync(self.append_async(rec))
+
+    # ----------------------------------------------------------- checkpoints
+
+    def should_checkpoint(self) -> bool:
+        return self._appendable and \
+            self._ops_since_ckpt >= self._checkpoint_every
 
     def checkpoint(self) -> None:
-        # Fenced like append: a split-brain old active must never overwrite
-        # the fsimage or truncate the shared WAL after a promotion.  The
-        # fence lock is held across the WHOLE checkpoint (snapshot, image
-        # publish, WAL truncate) — releasing it after the check would let a
-        # concurrent claim_epoch land between the check and the truncate,
-        # and the old active would then erase edits the new active already
-        # fsync'd and acked.
-        with self._fence_lock():
-            self._check_fence()
+        """Publish an fsimage covering everything durable, then drop the
+        covered journal prefix.  MUST be called with all applied records
+        already synced (the namespace snapshot must not embed edits the
+        journal could lose).  Local mode holds the journal's exclusive lock
+        across check + image publish + truncate so a just-fenced old active
+        cannot erase edits the new active acked; quorum mode needs no
+        global lock — the purge itself is epoch-checked at every node."""
+        self.sync(self._buffered_seq)
+        with self.journal.exclusive():
+            self.journal.check_fence()
             snapshot = self._snapshot_fn() if self._snapshot_fn else None
-            tmp = os.path.join(self._dir, IMG_TMP)
-            with open(tmp, "wb") as f:
-                f.write(msgpack.packb([self.seq, snapshot]))
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, os.path.join(self._dir, IMG_NAME))
+            self.write_image(self.seq, snapshot)
             fault_injection.point("editlog.post_checkpoint")
-            if self._wal is not None:
-                self._wal.truncate(0)
-                self._wal.seek(0)
+            self.journal.purge(self.seq)
         self._ops_since_ckpt = 0
 
+    def write_image(self, seq: int, snapshot: Any) -> None:
+        from hdrf_tpu.server.journal import _write_atomic
+
+        _write_atomic(os.path.join(self._dir, IMG_NAME),
+                      msgpack.packb([seq, snapshot]))
+
+    def read_image_bytes(self) -> bytes | None:
+        """Raw fsimage bytes (standby bootstrap fetch, rpc_fetch_image)."""
+        try:
+            with open(os.path.join(self._dir, IMG_NAME), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def write_image_bytes(self, data: bytes) -> None:
+        """Install a peer's fsimage (quorum-mode standby that fell behind
+        the journal's purge horizon); primes ``seq`` on next load_image."""
+        from hdrf_tpu.server.journal import _write_atomic
+
+        _write_atomic(os.path.join(self._dir, IMG_NAME), data)
+
     def close(self) -> None:
-        if self._wal is not None:
-            self._wal.close()
-            self._wal = None
-        if self._lock_f is not None:
-            self._lock_f.close()
-            self._lock_f = None
+        self.journal.close()

@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from hdrf_tpu.config import NameNodeConfig
-from hdrf_tpu.proto.rpc import RpcServer
+from hdrf_tpu.proto.rpc import RpcError, RpcServer
 from hdrf_tpu.server.editlog import EditLog
 from hdrf_tpu.utils import fault_injection, metrics
 
@@ -85,6 +85,12 @@ class LeaseManager:
     def __init__(self, expiry_s: float = 60.0):
         self.expiry_s = expiry_s
         self._leases: dict[str, tuple[str, float]] = {}  # path -> (client, deadline)
+
+    def clear(self) -> None:
+        """Demotion hygiene: a standby holds no leases (the active owns
+        lease management; stale entries would block creates after a later
+        promotion)."""
+        self._leases.clear()
 
     def check_available(self, path: str, client: str) -> None:
         """Raise iff another client holds a live lease (non-mutating — safe
@@ -174,10 +180,20 @@ class NameNode:
         self._tokens = (BlockTokenSecretManager()
                         if self.config.block_tokens else None)
         self._editlog = EditLog(self.config.meta_dir,
-                                self.config.editlog_checkpoint_every)
+                                self.config.editlog_checkpoint_every,
+                                journal_addrs=self.config.journal_addrs)
         self._load()
         self._load_decommissioning()
         self._safemode_auto = bool(self._blocks) and self.role == "active"
+        # Group commit (FSEditLog.logSync design): rpc_* handlers buffer
+        # edits under the namesystem lock and sync AFTER releasing it, so
+        # one fsync / quorum round covers every concurrent handler's
+        # records.  Wrapping the bound methods (instance attrs shadow the
+        # class) covers the RPC server and direct in-process callers alike.
+        self._sync_ctx = threading.local()
+        for _name in dir(type(self)):
+            if _name.startswith("rpc_"):
+                setattr(self, _name, self._sync_wrap(getattr(self, _name)))
         self._rpc = RpcServer(self.config.host, self.config.port, self, "namenode")
         self._monitor_stop = threading.Event()
         self._monitor: threading.Thread | None = None
@@ -211,11 +227,23 @@ class NameNode:
         if snap is not None:
             self._restore(snap)
         if self.role == "standby":
-            # tail-only: never truncate or append to the active's journal
-            self._editlog.replay(self._apply_tolerant, readonly=True)
+            from hdrf_tpu.server.editlog import JournalGapError
+
+            # tail-only: never truncate or append to the active's journal,
+            # and never apply past the quorum's committed floor
+            try:
+                self._editlog.replay(self._apply_tolerant, readonly=True)
+            except JournalGapError:
+                # quorum purged past our (possibly absent) image: the tailer
+                # loop bootstraps a newer image from the active peer
+                pass
         else:
-            self._editlog.replay(self._apply_tolerant)
+            # Claim BEFORE replaying: the claim fences older writers and (in
+            # quorum mode) runs segment recovery, so the replay reads a
+            # consistent, committed log.  Replaying first could apply a
+            # minority-only record that recovery then drops.
             self._editlog.claim_epoch()
+            self._editlog.replay(self._apply_tolerant)
             self._editlog.open_for_append(self._snapshot)
 
     def _reload_image(self, snap: dict) -> None:
@@ -391,6 +419,54 @@ class NameNode:
                     for r, _ in self._quota_roots_of(path):
                         self._qusage[r] = None
 
+    def _sync_wrap(self, fn):
+        """Bound-method wrapper giving every entry point group-commit
+        semantics: edits buffered by ``_log`` during the call are synced
+        (durably journaled) after the namesystem lock is released, before
+        the caller sees the result — the reference's handler shape
+        (mutate under lock, ``logSync`` outside it, FSEditLog.java:124).
+        Depth-tracked so nested rpc_* calls sync once, at the top."""
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            ctx = self._sync_ctx
+            ctx.depth = getattr(ctx, "depth", 0) + 1
+            try:
+                out = fn(*a, **kw)
+                if ctx.depth == 1:
+                    self._sync_pending()
+                return out
+            except BaseException:
+                if ctx.depth == 1:
+                    try:
+                        self._sync_pending()
+                    except Exception:  # noqa: BLE001 — original error wins
+                        pass
+                raise
+            finally:
+                ctx.depth -= 1
+        return wrapped
+
+    def _sync_pending(self) -> None:
+        """Make this thread's buffered edits durable; on a fencing or
+        quorum-loss failure the NN stops acking and demotes."""
+        from hdrf_tpu.server.editlog import FencedError, QuorumLostError
+
+        seq = getattr(self._sync_ctx, "pending", None)
+        if seq is None:
+            return
+        self._sync_ctx.pending = None
+        try:
+            self._editlog.sync(seq)
+        except FencedError:
+            self._demote()
+            raise StandbyError("namenode fenced: now standby") from None
+        except QuorumLostError:
+            self._demote()
+            raise StandbyError(
+                "journal quorum lost: namenode demoted") from None
+
     def _log(self, rec: list) -> None:
         """Validate, then append, then apply.  Validation (non-mutating)
         rejects bad ops — mkdir over a file, rename onto an existing dst —
@@ -398,7 +474,11 @@ class NameNode:
         replay; appending before applying keeps the log-before-apply
         durability discipline (editlog.py): if the append raises, memory is
         untouched and the client sees the error; if apply then raises, WAL
-        and memory agree again after a restart replays the record."""
+        and memory agree again after a restart replays the record.
+
+        The append is BUFFERED (group commit): inside an rpc_* call the
+        sync happens after the namesystem lock is released (_sync_wrap);
+        background callers (lease monitor, scanners) sync inline."""
         from hdrf_tpu.server.editlog import FencedError
 
         if self.role != "active":
@@ -406,11 +486,14 @@ class NameNode:
         self._check_safemode()
         self._validate(rec)
         try:
-            self._editlog.append(rec)
+            seq = self._editlog.append_async(rec)
         except FencedError:
             # another NN claimed the journal: demote (old-active fencing)
             self._demote()
             raise StandbyError("namenode fenced: now standby") from None
+        self._sync_ctx.pending = seq
+        if getattr(self._sync_ctx, "depth", 0) == 0:
+            self._sync_pending()  # background caller: durable before return
         if rec[0] == "complete" and self._quotas:
             delta = 0
             for bid, ln in rec[2].items():
@@ -430,8 +513,37 @@ class NameNode:
             self._emit_event(rec)
 
     def _demote(self) -> None:
-        self.role = "standby"
-        self._editlog.close()
+        """Fenced/quorum-lost active -> standby.  With group commit the
+        in-memory namespace may contain applied-but-never-durable edits
+        (the sync that failed), so the namespace is RELOADED from the
+        durable image + journal — a demoted NN must converge to what the
+        new active replays, not to its own unacked leftovers."""
+        with self._lock:
+            if self.role == "standby":
+                return
+            self.role = "standby"
+            self._editlog.close()
+            self._editlog = EditLog(self.config.meta_dir,
+                                    self.config.editlog_checkpoint_every,
+                                    journal_addrs=self.config.journal_addrs)
+            old_locs = {bid: info.locations
+                        for bid, info in self._blocks.items()}
+            self._restore({"tree": {}, "blocks": {}, "groups": {},
+                           "next_block_id": 1, "gen_stamp": 1})
+            self._leases.clear()
+            snap = self._editlog.load_image()
+            if snap is not None:
+                self._restore(snap)
+            try:
+                self._editlog.replay(self._apply_tolerant, readonly=True)
+            except Exception:  # noqa: BLE001 — tailer keeps retrying
+                _M.incr("tail_errors")
+            # re-seed block locations from the DN-report-built map (the
+            # whole point of a warm standby)
+            for bid, locs in old_locs.items():
+                info = self._blocks.get(bid)
+                if info is not None:
+                    info.locations |= locs
         tailer = threading.Thread(target=self._tailer_loop,
                                   name="nn-tailer", daemon=True)
         tailer.start()  # the running monitor loop exits on its role check
@@ -1451,6 +1563,30 @@ class NameNode:
         return {"role": self.role, "seq": self._editlog.seq,
                 "epoch": self._editlog.read_epoch()}
 
+    def rpc_fetch_image(self) -> dict:
+        """Serve this NN's fsimage bytes (image-transfer analog: the
+        reference moves images between NNs over its HTTP servlet; quorum
+        JournalNodes hold only edits, so a far-behind standby bootstraps
+        from a peer)."""
+        data = self._editlog.read_image_bytes()
+        return {"image": data, "seq": self._editlog.seq}
+
+    def _fetch_image_from_peer(self) -> bool:
+        from hdrf_tpu.proto.rpc import RpcClient
+
+        for addr in (self.config.peers or []):
+            try:
+                with RpcClient(tuple(addr), timeout=10.0) as c:
+                    r = c.call("fetch_image")
+                if r.get("image"):
+                    with self._lock:
+                        self._editlog.write_image_bytes(r["image"])
+                    _M.incr("image_bootstraps")
+                    return True
+            except (OSError, ConnectionError, RpcError):
+                continue
+        return False
+
     def rpc_transition_to_active(self) -> bool:
         """Manual/controller-driven failover (transitionToActive analog):
         final catch-up tail, claim the journal epoch (fencing the old
@@ -1466,9 +1602,22 @@ class NameNode:
             # be truncated before open_for_append, or every edit we append
             # behind it becomes unreachable to future replays.
             self._editlog.claim_epoch()
-            self._editlog.tail(self._apply_tolerant,
-                               reload_fn=self._reload_image,
-                               readonly=False)
+            from hdrf_tpu.server.editlog import JournalGapError
+            try:
+                self._editlog.tail(self._apply_tolerant,
+                                   reload_fn=self._reload_image,
+                                   readonly=False)
+            except JournalGapError:
+                # Lagged past the quorum's purge horizon: bootstrap the
+                # ex-active's image, then retry — failing here would leave
+                # the cluster active-less with the old writer already
+                # fenced.  (The claim is not undone: a retried transition
+                # simply claims the next epoch.)
+                if not self._fetch_image_from_peer():
+                    raise
+                self._editlog.tail(self._apply_tolerant,
+                                   reload_fn=self._reload_image,
+                                   readonly=False)
             self._drain_pending_ibr()
             self._editlog.open_for_append(self._snapshot)
             self._load_decommissioning()
@@ -1485,15 +1634,38 @@ class NameNode:
     def _tailer_loop(self) -> None:
         """Standby: periodically replay the shared journal
         (EditLogTailer.java:74 + StandbyCheckpointer roles)."""
+        from hdrf_tpu.server.editlog import JournalGapError
+
         interval = self.config.tail_interval_s
+        quorum = bool(self.config.journal_addrs)
+        applied_since_image = 0
         while not self._monitor_stop.wait(interval):
             if self.role != "standby":
                 return  # transitioned; monitor thread has taken over
             try:
                 with self._lock:
-                    self._editlog.tail(self._apply_tolerant,
-                                       reload_fn=self._reload_image)
+                    n = self._editlog.tail(self._apply_tolerant,
+                                           reload_fn=self._reload_image)
                     self._drain_pending_ibr()
+                applied_since_image += n
+                if quorum and applied_since_image >= \
+                        self.config.editlog_checkpoint_every:
+                    # Quorum-mode standby keeps its OWN local image current
+                    # (each NN owns its meta_dir; in shared-dir mode the
+                    # active owns the one shared image).  Everything the
+                    # standby applied is quorum-committed, so snapshotting
+                    # it is always safe.
+                    with self._lock:
+                        self._editlog.write_image(self._editlog.seq,
+                                                  self._snapshot())
+                    applied_since_image = 0
+            except JournalGapError:
+                # the journal was purged past our seq: bootstrap a newer
+                # image from the active peer, then resume tailing from it
+                if self._fetch_image_from_peer():
+                    applied_since_image = 0
+                else:
+                    _M.incr("tail_errors")
             except Exception:  # noqa: BLE001 — tailer must survive
                 _M.incr("tail_errors")
 
@@ -1510,6 +1682,13 @@ class NameNode:
                 self._check_replication()
                 self._settle_moves()
                 self._recover_leases()
+                if self._editlog.should_checkpoint():
+                    # Background checkpointer (SecondaryNameNode /
+                    # StandbyCheckpointer role): with group commit the
+                    # append path no longer checkpoints inline; the
+                    # namesystem lock makes the snapshot consistent.
+                    with self._lock:
+                        self._editlog.checkpoint()
             except Exception:  # noqa: BLE001 — monitor must survive
                 _M.incr("monitor_errors")
 

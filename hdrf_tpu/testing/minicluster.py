@@ -24,9 +24,14 @@ class MiniCluster:
     def __init__(self, n_datanodes: int = 3, base_dir: str | None = None,
                  replication: int = 3, block_size: int = 1 << 20,
                  container_size: int = 1 << 22, heartbeat_s: float = 0.2,
-                 dead_node_s: float = 1.5, ha: bool = False):
+                 dead_node_s: float = 1.5, ha: bool = False,
+                 journal_nodes: int = 0):
+        """``journal_nodes`` > 0 boots that many JournalNodes and puts the
+        edit log on the quorum (MiniQJMHACluster analog); each NN then gets
+        its OWN meta_dir (only the shared-dir deployment shares one)."""
         self.n_datanodes = n_datanodes
         self.ha = ha
+        self.n_journal = journal_nodes
         self._own_dir = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="hdrf-mini-")
         self.nn_config = NameNodeConfig(
@@ -37,22 +42,44 @@ class MiniCluster:
         self._heartbeat_s = heartbeat_s
         self.namenode: NameNode | None = None
         self.standby: NameNode | None = None  # MiniQJMHACluster analog
+        self.journalnodes: list = []
         self.datanodes: list[DataNode | None] = [None] * n_datanodes
 
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "MiniCluster":
+        import dataclasses
+
+        if self.n_journal:
+            from hdrf_tpu.server.journal import JournalNode
+
+            self.journalnodes = [
+                JournalNode(os.path.join(self.base_dir, f"jn{i}")).start()
+                for i in range(self.n_journal)]
+            self.nn_config = dataclasses.replace(
+                self.nn_config,
+                meta_dir=os.path.join(self.base_dir, "name-a"),
+                journal_addrs=[list(j.addr) for j in self.journalnodes])
         self.namenode = NameNode(self.nn_config).start()
         if self.ha:
-            import dataclasses
-
             sb_cfg = dataclasses.replace(self.nn_config, role="standby",
                                          port=0)
+            if self.n_journal:
+                sb_cfg = dataclasses.replace(
+                    sb_cfg, meta_dir=os.path.join(self.base_dir, "name-b"),
+                    peers=[list(self.namenode.addr)])
             self.standby = NameNode(sb_cfg).start()
+            if self.n_journal:
+                # peers must be symmetric: after a failover the DEMOTED
+                # original needs the new active for image bootstrap too
+                self.namenode.config.peers = [list(self.standby.addr)]
         for i in range(self.n_datanodes):
             self.datanodes[i] = self._make_dn(i).start()
         self.wait_for_datanodes(self.n_datanodes)
         return self
+
+    def stop_journalnode(self, i: int) -> None:
+        self.journalnodes[i].stop()
 
     def nn_addrs(self) -> list:
         addrs = [self.namenode.addr]
@@ -85,6 +112,11 @@ class MiniCluster:
             self.standby.stop()
         if self.namenode is not None:
             self.namenode.stop()
+        for jn in self.journalnodes:
+            try:
+                jn.stop()
+            except Exception:  # noqa: BLE001 — may already be stopped
+                pass
         if self._own_dir:
             shutil.rmtree(self.base_dir, ignore_errors=True)
 
