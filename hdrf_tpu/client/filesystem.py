@@ -47,6 +47,26 @@ class HdrfClient:
         addrs = normalize_addrs(namenode_addr)
         self._nn = (HaRpcClient(addrs) if len(addrs) > 1
                     else RpcClient(addrs[0]))
+        self._dtoken: dict | None = None
+        if self.config.use_delegation_tokens:
+            self._dtoken = self._nn.call("get_delegation_token",
+                                         renewer=self.name, owner=self.name)
+
+    def _call(self, method: str, **kw):
+        """NameNode RPC with the client's delegation token attached (the
+        UGI-token-selector analog: every call authenticates when the
+        cluster requires it)."""
+        if self._dtoken is not None:
+            kw["_dtoken"] = self._dtoken
+        return self._nn.call(method, **kw)
+
+    def renew_delegation_token(self) -> float:
+        return self._call("renew_delegation_token", token=self._dtoken)
+
+    def cancel_delegation_token(self) -> bool:
+        out = self._call("cancel_delegation_token", token=self._dtoken)
+        self._dtoken = None
+        return out
 
     def close(self) -> None:
         self._nn.close()
@@ -60,7 +80,7 @@ class HdrfClient:
     # ---------------------------------------------------------- namespace ops
 
     def mkdir(self, path: str) -> bool:
-        return self._nn.call("mkdir", path=path)
+        return self._call("mkdir", path=path)
 
     @staticmethod
     def _trash_root() -> str:
@@ -76,7 +96,7 @@ class HdrfClient:
         deleting (the fs.trash interval behavior; `expunge` empties).  Paths
         already inside the trash are always deleted permanently."""
         if skip_trash or path.startswith("/.Trash/"):
-            return self._nn.call("delete", path=path)
+            return self._call("delete", path=path)
         import time as _t
 
         if not self.exists(path):
@@ -87,7 +107,7 @@ class HdrfClient:
             # path: disambiguate like HDFS's .1/.2 suffixes
             dst = base if attempt == 0 else f"{base}.{attempt}"
             try:
-                return self._nn.call("rename", src=path, dst=dst)
+                return self._call("rename", src=path, dst=dst)
             except Exception as e:
                 if getattr(e, "error", "") != "FileExistsError":
                     raise
@@ -111,52 +131,52 @@ class HdrfClient:
             except ValueError:
                 continue
             if ts <= cutoff:
-                if self._nn.call(
+                if self._call(
                         "delete", path=f"{self._trash_root()}/{e['name']}"):
                     removed += 1
         return removed
 
     def rename(self, src: str, dst: str) -> bool:
-        return self._nn.call("rename", src=src, dst=dst)
+        return self._call("rename", src=src, dst=dst)
 
     def ls(self, path: str) -> list[dict]:
-        return self._nn.call("listing", path=path)
+        return self._call("listing", path=path)
 
     def stat(self, path: str) -> dict:
-        return self._nn.call("stat", path=path)
+        return self._call("stat", path=path)
 
     def exists(self, path: str) -> bool:
         try:
-            self._nn.call("stat", path=path)
+            self._call("stat", path=path)
             return True
         except Exception:
             return False
 
     def datanode_report(self) -> list[dict]:
-        return self._nn.call("datanode_report")
+        return self._call("datanode_report")
 
     # ------------------------------------------------- snapshots and quotas
 
     def allow_snapshot(self, path: str) -> bool:
-        return self._nn.call("allow_snapshot", path=path)
+        return self._call("allow_snapshot", path=path)
 
     def create_snapshot(self, path: str, name: str) -> bool:
-        return self._nn.call("create_snapshot", path=path, name=name)
+        return self._call("create_snapshot", path=path, name=name)
 
     def delete_snapshot(self, path: str, name: str) -> bool:
-        return self._nn.call("delete_snapshot", path=path, name=name)
+        return self._call("delete_snapshot", path=path, name=name)
 
     def list_snapshots(self, path: str) -> list[str]:
-        return self._nn.call("list_snapshots", path=path)
+        return self._call("list_snapshots", path=path)
 
     def set_quota(self, path: str, namespace_quota: int = -1,
                   space_quota: int = -1) -> bool:
-        return self._nn.call("set_quota", path=path,
+        return self._call("set_quota", path=path,
                              namespace_quota=namespace_quota,
                              space_quota=space_quota)
 
     def content_summary(self, path: str) -> dict:
-        return self._nn.call("content_summary", path=path)
+        return self._call("content_summary", path=path)
 
     def events(self, since_seq: int = 0, poll_s: float = 0.2):
         """Namespace event iterator (DFSInotifyEventInputStream analog):
@@ -168,7 +188,7 @@ class HdrfClient:
 
         seq = since_seq
         while True:
-            resp = self._nn.call("get_events", since_seq=seq)
+            resp = self._call("get_events", since_seq=seq)
             if seq and resp["trimmed_through"] > seq:
                 raise IOError(
                     f"event stream gap: events through "
@@ -199,7 +219,7 @@ class HdrfClient:
                 StripedWriter(self).write(path, data, ec)
                 _M.incr("files_written")
                 return
-            info = self._nn.call("create", path=path, client=self.name,
+            info = self._call("create", path=path, client=self.name,
                                  replication=replication, scheme=scheme)
             block_size = info["block_size"]
             lengths: dict[int, int] = {}
@@ -215,7 +235,7 @@ class HdrfClient:
                 # LeaseRenewer analog: time-based, at 1/3 of the 60 s lease
                 # expiry — a slow write must not outlive its lease
                 if _t.monotonic() - last_renew > 20.0:
-                    self._nn.call("renew_lease", client=self.name)
+                    self._call("renew_lease", client=self.name)
                     last_renew = _t.monotonic()
                 if off >= len(data):
                     break
@@ -231,7 +251,7 @@ class HdrfClient:
 
         deadline = _t.monotonic() + timeout
         while True:
-            if self._nn.call("complete", path=path, client=self.name,
+            if self._call("complete", path=path, client=self.name,
                              block_lengths=lengths):
                 return
             if _t.monotonic() > deadline:
@@ -241,7 +261,7 @@ class HdrfClient:
     def _write_block(self, path: str, block: bytes, retries: int = 3) -> int:
         last_err: Exception | None = None
         for _ in range(retries):
-            alloc = self._nn.call("add_block", path=path, client=self.name)
+            alloc = self._call("add_block", path=path, client=self.name)
             bid = alloc["block_id"]
             try:
                 self._stream_block(alloc, block)
@@ -249,7 +269,7 @@ class HdrfClient:
             except (OSError, ConnectionError, IOError) as e:
                 last_err = e
                 _M.incr("block_write_retries")
-                self._nn.call("abandon_block", path=path, client=self.name,
+                self._call("abandon_block", path=path, client=self.name,
                               block_id=bid)
         raise IOError(f"block write failed after {retries} attempts: {last_err}")
 
@@ -258,6 +278,8 @@ class HdrfClient:
         sock = socket.create_connection(tuple(targets[0]["addr"]), timeout=120)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock = dt.secure_socket(sock, alloc.get("token"),
+                                    self.config.encrypt_data_transfer)
             dt.send_op(sock, dt.WRITE_BLOCK, block_id=alloc["block_id"],
                        gen_stamp=alloc["gen_stamp"], scheme=alloc["scheme"],
                        token=alloc.get("token"), targets=targets[1:])
@@ -277,7 +299,7 @@ class HdrfClient:
         """Read [offset, offset+length) of a file (whole file by default)."""
         with _TR.span("read") as sp:
             sp.annotate("path", path)
-            loc = self._nn.call("get_block_locations", path=path)
+            loc = self._call("get_block_locations", path=path)
             total = loc["length"]
             end = total if length < 0 else min(offset + length, total)
             if offset >= end:
@@ -338,6 +360,8 @@ class HdrfClient:
         sock = socket.create_connection(addr, timeout=120)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock = dt.secure_socket(sock, token,
+                                    self.config.encrypt_data_transfer)
             dt.send_op(sock, dt.READ_BLOCK, block_id=block_id, offset=offset,
                        length=length, token=token)
             hdr = recv_frame(sock)

@@ -66,7 +66,7 @@ class StripedWriter:
     def write(self, path: str, data: bytes, policy: str) -> None:
         c = self._c
         k, m, cell = rs.parse_policy(policy)
-        info = c._nn.call("create", path=path, client=c.name, ec=policy)
+        info = c._call("create", path=path, client=c.name, ec=policy)
         group_capacity = k * info["block_size"]
         lengths: dict[int, int] = {}
         off = 0
@@ -84,7 +84,7 @@ class StripedWriter:
     def _write_group(self, path: str, chunk: bytes, k: int, m: int,
                      cell: int) -> int:
         c = self._c
-        alloc = c._nn.call("add_block_group", path=path, client=c.name)
+        alloc = c._call("add_block_group", path=path, client=c.name)
         assert alloc["k"] == k and alloc["m"] == m
         shards = layout_shards(chunk, k, cell)
         parity = rs.rs_encode(shards, k, m)
@@ -99,6 +99,8 @@ class StripedWriter:
                                         timeout=120)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock = dt.secure_socket(sock, blk.get("token"),
+                                    c.config.encrypt_data_transfer)
             dt.send_op(sock, dt.WRITE_BLOCK, block_id=blk["block_id"],
                        gen_stamp=gen_stamp, scheme="direct",
                        token=blk.get("token"), targets=[])
@@ -177,7 +179,9 @@ class StripedReader:
             try:
                 return dt.fetch_block(tuple(locd["addr"]), blk["block_id"],
                                       offset, length,
-                                      token=blk.get("token"))
+                                      token=blk.get("token"),
+                                      encrypt=self._c.config
+                                      .encrypt_data_transfer)
             except (OSError, ConnectionError, IOError):
                 _M.incr("ec_shard_read_failures")
         return None

@@ -94,6 +94,10 @@ class NameNodeConfig:
     # Block access tokens (dfs.block.access.token.enable analog): NN mints
     # HMAC tokens, DNs verify; keys ride heartbeat responses.
     block_tokens: bool = False
+    # Require a valid delegation token on client namespace RPCs
+    # (hadoop.security.authentication=token analog; DN-protocol and
+    # token-acquisition methods stay open — kerberos has no analog here).
+    require_token_auth: bool = False
     # Startup safemode: hold mutations until this fraction of known blocks
     # has a reported replica (dfs.namenode.safemode.threshold-pct analog).
     safemode_threshold: float = 0.999
@@ -127,6 +131,10 @@ class DataNodeConfig:
     # RAM-backed fake dataset for protocol tests at scale
     # (SimulatedFSDataset analog).
     simulated_dataset: bool = False
+    # Require + speak the encrypted data-transfer handshake
+    # (dfs.encrypt.data.transfer): plaintext ops are refused, and this DN's
+    # own outgoing legs (mirroring, transfers, reconstruction) encrypt.
+    encrypt_data_transfer: bool = False
     reduction: ReductionConfig = field(default_factory=ReductionConfig)
 
 
@@ -139,6 +147,12 @@ class ClientConfig:
     # Short-circuit local reads: fd passing over the DN's unix socket
     # (dfs.client.read.shortcircuit analog).
     short_circuit: bool = True
+    # Encrypt block data on the wire (dfs.encrypt.data.transfer analog);
+    # needs block tokens enabled — the token signature keys the handshake.
+    encrypt_data_transfer: bool = False
+    # Fetch a delegation token at connect and attach it to every NameNode
+    # RPC (the kerberos-bootstrapped token flow, minus kerberos).
+    use_delegation_tokens: bool = False
 
 
 @dataclass

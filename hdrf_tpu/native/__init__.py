@@ -75,6 +75,13 @@ def _load() -> ctypes.CDLL:
         lib.hdrf_lz4_emit.restype = ctypes.c_uint64
         lib.hdrf_crc32c.argtypes = [ctypes.c_uint32, _u8p, ctypes.c_uint64]
         lib.hdrf_crc32c.restype = ctypes.c_uint32
+        lib.hdrf_chacha20_xor.argtypes = [_u8p, _u8p, ctypes.c_uint32, _u8p,
+                                          ctypes.c_uint64, _u8p]
+        lib.hdrf_aead_seal.argtypes = [_u8p, _u8p, _u8p, ctypes.c_uint64,
+                                       _u8p, ctypes.c_uint64, _u8p]
+        lib.hdrf_aead_open.argtypes = [_u8p, _u8p, _u8p, ctypes.c_uint64,
+                                       _u8p, ctypes.c_uint64, _u8p]
+        lib.hdrf_aead_open.restype = ctypes.c_int
         lib.hdrf_crc32c_chunks.argtypes = [_u8p, ctypes.c_uint64, ctypes.c_uint64, _u32p]
         _lib = lib
         return lib
@@ -202,6 +209,48 @@ def lz4_decompress(data: bytes | np.ndarray, decompressed_size: int) -> bytes:
     if n != decompressed_size:
         raise RuntimeError(f"lz4 decompression failed: got {n}, want {decompressed_size}")
     return out.tobytes()
+
+
+def chacha20_xor(key: bytes, nonce: bytes, data: bytes | np.ndarray,
+                 counter: int = 1) -> bytes:
+    """Raw ChaCha20 keystream XOR (RFC 8439)."""
+    assert len(key) == 32 and len(nonce) == 12
+    a = _as_u8(data)
+    out = np.empty(a.size, dtype=np.uint8)
+    _load().hdrf_chacha20_xor(_ptr(np.frombuffer(key, np.uint8), _u8p),
+                              _ptr(np.frombuffer(nonce, np.uint8), _u8p),
+                              counter, _ptr(a, _u8p), a.size, _ptr(out, _u8p))
+    return out.tobytes()
+
+
+def aead_seal(key: bytes, nonce: bytes, aad: bytes,
+              plaintext: bytes | np.ndarray) -> bytes:
+    """ChaCha20-Poly1305 seal: ciphertext || 16-byte tag."""
+    assert len(key) == 32 and len(nonce) == 12
+    a = _as_u8(plaintext)
+    ad = np.frombuffer(aad, np.uint8) if aad else np.empty(0, np.uint8)
+    out = np.empty(a.size + 16, dtype=np.uint8)
+    _load().hdrf_aead_seal(_ptr(np.frombuffer(key, np.uint8), _u8p),
+                           _ptr(np.frombuffer(nonce, np.uint8), _u8p),
+                           _ptr(ad, _u8p), ad.size, _ptr(a, _u8p), a.size,
+                           _ptr(out, _u8p))
+    return out.tobytes()
+
+
+def aead_open(key: bytes, nonce: bytes, aad: bytes,
+              sealed: bytes | np.ndarray) -> bytes | None:
+    """ChaCha20-Poly1305 open; None if authentication fails."""
+    assert len(key) == 32 and len(nonce) == 12
+    a = _as_u8(sealed)
+    if a.size < 16:
+        return None
+    ad = np.frombuffer(aad, np.uint8) if aad else np.empty(0, np.uint8)
+    out = np.empty(a.size - 16, dtype=np.uint8)
+    ok = _load().hdrf_aead_open(_ptr(np.frombuffer(key, np.uint8), _u8p),
+                                _ptr(np.frombuffer(nonce, np.uint8), _u8p),
+                                _ptr(ad, _u8p), ad.size, _ptr(a, _u8p),
+                                a.size - 16, _ptr(out, _u8p))
+    return out.tobytes() if ok else None
 
 
 def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:

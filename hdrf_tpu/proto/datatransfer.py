@@ -51,6 +51,20 @@ COPY_BLOCK = "copy_block"
 BLOCK_CHECKSUM = "block_checksum"
 
 
+def secure_socket(sock: socket.socket, token: dict | None, encrypt: bool):
+    """Wrap a freshly connected data socket with the AEAD record layer when
+    encryption is on (security.client_handshake, keyed by the block token —
+    the datatransfer/sasl analog).  Returns the socket to use for the op."""
+    if not encrypt:
+        return sock
+    from hdrf_tpu import security
+
+    if not token or not token.get("sig"):
+        raise PermissionError("data-transfer encryption requires block "
+                              "tokens (dfs.block.access.token.enable)")
+    return security.client_handshake(sock, token)
+
+
 def send_op(sock: socket.socket, op: str, **fields: Any) -> None:
     tr = tracing.current_context()
     if tr is not None:
@@ -113,7 +127,7 @@ def stream_bytes(sock: socket.socket, data: bytes,
 
 def fetch_block(addr: tuple, block_id: int, offset: int = 0,
                 length: int = -1, timeout: float = 60,
-                token: dict | None = None) -> bytes:
+                token: dict | None = None, encrypt: bool = False) -> bytes:
     """One-shot READ_BLOCK: connect, request [offset, offset+length), collect
     the packet run, length-check.  Shared by the EC degraded-read path
     (client/striped.py) and DN reconstruction fan-in (server/datanode.py)."""
@@ -122,6 +136,7 @@ def fetch_block(addr: tuple, block_id: int, offset: int = 0,
     sock = socket.create_connection(addr, timeout=timeout)
     try:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock = secure_socket(sock, token, encrypt)
         send_op(sock, READ_BLOCK, block_id=block_id, offset=offset,
                 length=length, token=token)
         hdr = recv_frame(sock)

@@ -113,9 +113,18 @@ class RpcServer:
         req_id, method, kwargs = req
         trace = kwargs.pop("_trace", None)
         retry_id = kwargs.pop("_retry_id", None)
+        dtoken = kwargs.pop("_dtoken", None)
         fn = getattr(self._service, f"rpc_{method}", None)
         if fn is None:
             return [req_id, 1, {"error": "NoSuchMethod", "message": method}]
+        auth = getattr(self._service, "_rpc_auth_hook", None)
+        if auth is not None:
+            try:
+                auth(method, dtoken)
+            except Exception as e:  # noqa: BLE001 — refusal crosses the wire
+                self._metrics.incr(f"{method}_auth_rejected")
+                return [req_id, 1, {"error": type(e).__name__,
+                                    "message": str(e)}]
         if retry_id is not None:
             cached = self._retry_cache_get(retry_id)
             if cached is not None:

@@ -46,9 +46,17 @@ def _checksums(data: bytes, chunk: int) -> list[int]:
     return [int(c) for c in native.crc32c_chunks(data, chunk)]
 
 
-def _connect(addr: list | tuple) -> socket.socket:
+def _connect(addr: list | tuple, dn=None, block_id: int | None = None,
+             token: dict | None = None) -> socket.socket:
+    """Mirror-leg socket; encrypts when this DN is configured to (the
+    reference's DN->DN SASL legs — tokens minted from the shared block keys
+    when the incoming op's token isn't reusable)."""
     s = socket.create_connection((addr[0], addr[1]), timeout=60)
     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    if dn is not None and dn.config.encrypt_data_transfer:
+        if not token or not token.get("sig"):
+            token = dn.tokens.mint(block_id, "w")
+        s = dt.secure_socket(s, token, True)
     return s
 
 
@@ -68,7 +76,8 @@ class BlockReceiver:
             writer = dn.replicas.create_rbw(block_id, gen_stamp)
             try:
                 if targets:
-                    mirror_sock = _connect(targets[0]["addr"])
+                    mirror_sock = _connect(targets[0]["addr"], dn, block_id,
+                                           fields.get("token"))
                     dt.send_op(mirror_sock, dt.WRITE_BLOCK,
                                **{**fields, "targets": targets[1:]})
                 crcs: list[int] = []
@@ -178,7 +187,7 @@ class BlockReceiver:
         reconstructing FULL bytes, §3.3 note)."""
         dn = self._dn
         scheme = dn.scheme(scheme_name)
-        mirror = _connect(targets[0]["addr"])
+        mirror = _connect(targets[0]["addr"], dn, block_id)
         try:
             if getattr(scheme, "container_codec", None) is not None:
                 # dedup family: hashes + need-list negotiation + chunk delta

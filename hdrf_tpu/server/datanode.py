@@ -227,10 +227,29 @@ class DataNode:
     # ---------------------------------------------------------- xceiver loop
 
     def _xceive(self, sock: socket.socket) -> None:
+        from hdrf_tpu import security
+
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             op, fields = dt.recv_op(sock)
+            if op == security.HANDSHAKE_OP:
+                # Encrypted connection: run the token-keyed handshake, then
+                # read the real op off the AEAD channel.  The authenticated
+                # token doubles as the op's token when none is carried.
+                sock, hs_token = security.server_handshake(
+                    sock, fields, self.tokens._keys)
+                op, fields = dt.recv_op(sock)
+                fields.setdefault("token", hs_token)
+            elif self.config.encrypt_data_transfer:
+                _M.incr("plaintext_refused")
+                sock.close()
+                return  # strict mode: no plaintext ops
+        except PermissionError:
+            _M.incr("op_auth_failures")
+            sock.close()
+            return
         except (ConnectionError, OSError):
+            sock.close()
             return
         fault_injection.point("datanode.op", op=op)
         try:
@@ -279,9 +298,11 @@ class DataNode:
         ok = 0
         for c in ([nn] if nn else self._nns):
             try:
-                c.call("register_datanode", dn_id=self.dn_id,
-                       addr=list(self.addr), sc_path=self._sc.path,
-                       rack=self.config.rack)
+                resp = c.call("register_datanode", dn_id=self.dn_id,
+                              addr=list(self.addr), sc_path=self._sc.path,
+                              rack=self.config.rack)
+                if resp.get("block_keys"):
+                    self.tokens.update_keys(resp["block_keys"])
                 self._send_block_report(c)
                 ok += 1
             except (OSError, ConnectionError):
@@ -389,7 +410,8 @@ class DataNode:
                 try:
                     data = dt.fetch_block(
                         tuple(loc["addr"]), surv["block_id"],
-                        token=self.tokens.mint(surv["block_id"], "r"))
+                        token=self.tokens.mint(surv["block_id"], "r"),
+                        encrypt=self.config.encrypt_data_transfer)
                     shards[surv["index"]] = np.frombuffer(data, dtype=np.uint8)
                     break
                 except (OSError, ConnectionError, IOError):

@@ -153,7 +153,7 @@ class HttpGateway:
 
     def metrics(self) -> dict:
         with HdrfClient(self._nn_addr, name="http-gw") as c:
-            return c._nn.call("metrics")
+            return c._call("metrics")
 
     def explorer(self, path: str) -> str:
         """Minimal namespace browser (the NN webapp's explorer.html analog).

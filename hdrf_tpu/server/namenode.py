@@ -176,9 +176,11 @@ class NameNode:
         self._qusage: dict[str, list | None] = {}
         self._next_block_id = 1
         self._gen_stamp = 1
-        from hdrf_tpu.security import BlockTokenSecretManager
+        from hdrf_tpu.security import (BlockTokenSecretManager,
+                                       DelegationTokenManager)
         self._tokens = (BlockTokenSecretManager()
                         if self.config.block_tokens else None)
+        self._dtokens = DelegationTokenManager()
         self._editlog = EditLog(self.config.meta_dir,
                                 self.config.editlog_checkpoint_every,
                                 journal_addrs=self.config.journal_addrs)
@@ -290,6 +292,7 @@ class NameNode:
             "snapshottable": sorted(self._snapshottable),
             "snapshots": self._snapshots,
             "quotas": {p: list(q) for p, q in self._quotas.items()},
+            "dtokens": self._dtokens.snapshot(),
         }
 
     def _restore(self, snap: dict) -> None:
@@ -314,6 +317,8 @@ class NameNode:
                         for p, q in snap.get("quotas", {}).items()}
         self._next_block_id = snap["next_block_id"]
         self._gen_stamp = snap["gen_stamp"]
+        if "dtokens" in snap:
+            self._dtokens.restore(snap["dtokens"])
 
     def _apply(self, rec: list) -> None:
         """Apply one edit record (replay path and live path share this)."""
@@ -374,6 +379,15 @@ class NameNode:
             self._snapshots.setdefault(path, {})[name] = self._freeze(node)
         elif op == "delete_snapshot":
             self._delete_snapshot_apply(rec[1], rec[2])
+        elif op == "dt_key":
+            self._dtokens.apply_key(rec[1], rec[2],
+                                    rec[3] if len(rec) > 3 else 0.0)
+        elif op == "dt_issue":
+            self._dtokens.apply_issue(rec[1], rec[2])
+        elif op == "dt_renew":
+            self._dtokens.apply_renew(rec[1], rec[2])
+        elif op == "dt_cancel":
+            self._dtokens.apply_cancel(rec[1])
         elif op == "set_quota":
             _, path, ns_q, sp_q = rec
             path = "/" + "/".join(self._parts(path))
@@ -1160,7 +1174,15 @@ class NameNode:
                 dn_id, (addr[0], addr[1]), last_heartbeat=time.monotonic(),
                 sc_path=sc_path, rack=rack)
             _M.incr("dn_registered")
-            return {"heartbeat_interval_s": self.config.heartbeat_interval_s}
+            keys = None
+            if self._tokens is not None:
+                # keys ship WITH registration (the reference's
+                # DatanodeRegistration carries ExportedBlockKeys) — a DN must
+                # be able to verify tokens before its first heartbeat
+                self._tokens.maybe_roll()
+                keys = self._tokens.keys()
+            return {"heartbeat_interval_s": self.config.heartbeat_interval_s,
+                    "block_keys": keys}
 
     def rpc_heartbeat(self, dn_id: str, stats: dict | None = None) -> dict:
         with self._lock:
@@ -1563,6 +1585,66 @@ class NameNode:
         return {"role": self.role, "seq": self._editlog.seq,
                 "epoch": self._editlog.read_epoch()}
 
+    # ------------------------------------------------- delegation tokens
+
+    # Methods reachable without a delegation token when require_token_auth
+    # is on: the DN protocol (DNs authenticate via the shared block keys /
+    # deployment perimeter, as in the reference's service principals), HA
+    # and journal plumbing, and token acquisition itself (the kerberos leg
+    # that gates issuance in the reference has no analog here).
+    _AUTH_EXEMPT = frozenset({
+        "register_datanode", "heartbeat", "block_report",
+        "incremental_block_report", "bad_block", "block_received",
+        "ha_state", "transition_to_active", "fetch_image",
+        "get_delegation_token", "renew_delegation_token",
+        "cancel_delegation_token",
+    })
+
+    def _rpc_auth_hook(self, method: str, dtoken: dict | None) -> None:
+        """Called by RpcServer before every dispatch.  In-process callers
+        (tests, embedded use) bypass it — the wire is the trust boundary,
+        same as the reference's IPC-layer SASL authentication."""
+        if not self.config.require_token_auth or method in self._AUTH_EXEMPT:
+            return
+        self._dtokens.verify(dtoken)
+
+    def rpc_get_delegation_token(self, renewer: str = "",
+                                 owner: str = "") -> dict:
+        """Issue a delegation token (FSNamesystem.getDelegationToken): the
+        identifier + master key id are journaled, so a promoted standby
+        keeps verifying and renewing mid-lifetime tokens."""
+        with self._lock:
+            if self.role != "active":
+                raise StandbyError("namenode is standby")
+            nk = self._dtokens.need_key()
+            if nk is not None:
+                self._log(["dt_key", nk[0], nk[1], nk[2]])
+            ident = self._dtokens.build_identifier(owner or "anonymous",
+                                                   renewer)
+            expiry = time.time() + self._dtokens.renew_interval_s
+            self._log(["dt_issue", ident, expiry])
+            return {**ident, "password": self._dtokens.password(ident),
+                    "expiry": expiry}
+
+    def rpc_renew_delegation_token(self, token: dict) -> float:
+        with self._lock:
+            if self.role != "active":
+                raise StandbyError("namenode is standby")
+            self._dtokens.verify(token)
+            expiry = self._dtokens.check_renew(token["seq"],
+                                               token.get("renewer", ""))
+            self._log(["dt_renew", int(token["seq"]), expiry])
+            return expiry
+
+    def rpc_cancel_delegation_token(self, token: dict) -> bool:
+        with self._lock:
+            if self.role != "active":
+                raise StandbyError("namenode is standby")
+            self._dtokens.verify(token)
+            self._dtokens.check_cancel(token["seq"], token.get("owner", ""))
+            self._log(["dt_cancel", int(token["seq"])])
+            return True
+
     def rpc_fetch_image(self) -> dict:
         """Serve this NN's fsimage bytes (image-transfer analog: the
         reference moves images between NNs over its HTTP servlet; quorum
@@ -1682,6 +1764,8 @@ class NameNode:
                 self._check_replication()
                 self._settle_moves()
                 self._recover_leases()
+                with self._lock:
+                    self._dtokens.purge_expired()
                 if self._editlog.should_checkpoint():
                     # Background checkpointer (SecondaryNameNode /
                     # StandbyCheckpointer role): with group commit the

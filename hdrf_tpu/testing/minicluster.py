@@ -25,19 +25,23 @@ class MiniCluster:
                  replication: int = 3, block_size: int = 1 << 20,
                  container_size: int = 1 << 22, heartbeat_s: float = 0.2,
                  dead_node_s: float = 1.5, ha: bool = False,
-                 journal_nodes: int = 0):
+                 journal_nodes: int = 0, secure: bool = False):
         """``journal_nodes`` > 0 boots that many JournalNodes and puts the
         edit log on the quorum (MiniQJMHACluster analog); each NN then gets
-        its OWN meta_dir (only the shared-dir deployment shares one)."""
+        its OWN meta_dir (only the shared-dir deployment shares one).
+        ``secure`` turns on the whole security matrix: block tokens,
+        delegation-token-authenticated RPCs, and encrypted data transfer."""
         self.n_datanodes = n_datanodes
         self.ha = ha
         self.n_journal = journal_nodes
+        self.secure = secure
         self._own_dir = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="hdrf-mini-")
         self.nn_config = NameNodeConfig(
             port=0, meta_dir=os.path.join(self.base_dir, "name"),
             replication=replication, block_size=block_size,
-            heartbeat_interval_s=heartbeat_s, dead_node_interval_s=dead_node_s)
+            heartbeat_interval_s=heartbeat_s, dead_node_interval_s=dead_node_s,
+            block_tokens=secure, require_token_auth=secure)
         self._dn_kw = dict(container_size=container_size)
         self._heartbeat_s = heartbeat_s
         self.namenode: NameNode | None = None
@@ -102,6 +106,7 @@ class MiniCluster:
             block_report_interval_s=5.0)
         cfg.reduction.container_size = self._dn_kw["container_size"]
         cfg.reduction.backend = "native"  # deterministic in tests
+        cfg.encrypt_data_transfer = self.secure
         return DataNode(cfg, self.nn_addrs(), dn_id=f"dn-{i}")
 
     def stop(self) -> None:
@@ -164,8 +169,13 @@ class MiniCluster:
     # ------------------------------------------------------------- helpers
 
     def client(self, name: str | None = None) -> HdrfClient:
+        from hdrf_tpu.config import ClientConfig
+
         addrs = self.nn_addrs()
-        return HdrfClient(addrs if len(addrs) > 1 else addrs[0], name=name)
+        cfg = ClientConfig(encrypt_data_transfer=self.secure,
+                           use_delegation_tokens=self.secure)
+        return HdrfClient(addrs if len(addrs) > 1 else addrs[0], name=name,
+                          config=cfg)
 
     def wait_for_datanodes(self, n: int, timeout: float = 10.0) -> None:
         deadline = time.monotonic() + timeout

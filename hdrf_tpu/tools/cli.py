@@ -33,8 +33,15 @@ def _addr(s: str) -> tuple[str, int]:
 
 def _client(args):
     from hdrf_tpu.client.filesystem import HdrfClient
+    from hdrf_tpu.config import ClientConfig
 
-    return HdrfClient(_addr(args.namenode))
+    # --secure (or HDRF_SECURE=1): fetch a delegation token and encrypt the
+    # data wire — required against require_token_auth/encrypted clusters.
+    secure = bool(getattr(args, "secure", False) or
+                  os.environ.get("HDRF_SECURE"))
+    cfg = ClientConfig(use_delegation_tokens=secure,
+                       encrypt_data_transfer=secure)
+    return HdrfClient(_addr(args.namenode), config=cfg)
 
 
 # ------------------------------------------------------------------- daemons
@@ -156,10 +163,10 @@ def cmd_dfsadmin(args) -> int:
                       f"logical={stats.get('logical_bytes', 0)} "
                       f"physical={stats.get('physical_bytes', 0)}")
         elif args.op == "-savenamespace":
-            c._nn.call("save_namespace")
+            c._call("save_namespace")
             print("namespace saved")
         elif args.op == "-metrics":
-            print(json.dumps(c._nn.call("metrics"), indent=2, sort_keys=True))
+            print(json.dumps(c._call("metrics"), indent=2, sort_keys=True))
         elif args.op == "-allowSnapshot":
             c.allow_snapshot(args.args[0])
             print(f"snapshots enabled on {args.args[0]}")
@@ -170,21 +177,21 @@ def cmd_dfsadmin(args) -> int:
         elif args.op == "-clrQuota":
             c.set_quota(args.args[0])
         elif args.op == "-recoverLease":
-            ok = c._nn.call("recover_lease", path=args.args[0])
+            ok = c._call("recover_lease", path=args.args[0])
             print("recovered" if ok else "not recovered")
         elif args.op == "-safemode":
             mode = args.args[0] if args.args else "get"
-            on = c._nn.call("safemode", action=mode)
+            on = c._call("safemode", action=mode)
             print(f"Safe mode is {'ON' if on else 'OFF'}")
         elif args.op == "-decommission":
-            ok = c._nn.call("decommission", dn_id=args.args[0])
+            ok = c._call("decommission", dn_id=args.args[0])
             print("decommissioning" if ok else "unknown datanode")
             return 0 if ok else 1
         elif args.op == "-recommission":
-            ok = c._nn.call("recommission", dn_id=args.args[0])
+            ok = c._call("recommission", dn_id=args.args[0])
             print("recommissioned" if ok else "was not decommissioning")
         elif args.op == "-decommissionStatus":
-            st = c._nn.call("decommission_status", dn_id=args.args[0])
+            st = c._call("decommission_status", dn_id=args.args[0])
             print(f"{args.args[0]}: {st['state']} remaining={st['remaining']}")
         elif args.op == "-haState":
             from hdrf_tpu.proto.rpc import RpcClient
@@ -204,7 +211,7 @@ def cmd_dfsadmin(args) -> int:
             print("transitioned")
         elif args.op == "-movblock":
             bid, src, dst = args.args
-            ok = c._nn.call("move_block", block_id=int(bid), from_dn=src,
+            ok = c._call("move_block", block_id=int(bid), from_dn=src,
                             to_dn=dst)
             print("scheduled" if ok else "rejected")
             return 0 if ok else 1
@@ -284,11 +291,11 @@ def cmd_balancer(args) -> int:
                 return 0
             moved = 0
             for src in over:
-                blocks = c._nn.call("datanode_blocks", dn_id=src["dn_id"],
+                blocks = c._call("datanode_blocks", dn_id=src["dn_id"],
                                     limit=args.batch)
                 for bid in blocks:
                     dst = under[moved % len(under)]
-                    if c._nn.call("move_block", block_id=bid,
+                    if c._call("move_block", block_id=bid,
                                   from_dn=src["dn_id"], to_dn=dst["dn_id"]):
                         moved += 1
                     if moved >= args.batch:
@@ -314,22 +321,26 @@ def main(argv: list[str] | None = None) -> int:
     d = sub.add_parser("datanode")
     d.add_argument("--config", default=None)
     d.add_argument("--namenode", required=True)
+    d.add_argument("--secure", action="store_true")
     d.add_argument("--data-dir", default=None)
     d.set_defaults(fn=cmd_datanode)
 
     d = sub.add_parser("httpfs")
     d.add_argument("--namenode", required=True)
+    d.add_argument("--secure", action="store_true")
     d.add_argument("--port", type=int, default=9870)
     d.set_defaults(fn=cmd_httpfs)
 
     d = sub.add_parser("dfs")
     d.add_argument("--namenode", required=True)
+    d.add_argument("--secure", action="store_true")
     d.add_argument("--scheme", default=None)
     d.add_argument("--ec", default=None)
     d.set_defaults(fn=cmd_dfs, takes_ops=True)
 
     d = sub.add_parser("dfsadmin")
     d.add_argument("--namenode", required=True)
+    d.add_argument("--secure", action="store_true")
     d.set_defaults(fn=cmd_dfsadmin, takes_ops=True)
 
     d = sub.add_parser("oiv")
@@ -342,6 +353,7 @@ def main(argv: list[str] | None = None) -> int:
 
     d = sub.add_parser("balancer")
     d.add_argument("--namenode", required=True)
+    d.add_argument("--secure", action="store_true")
     d.add_argument("--threshold", type=float, default=2.0)
     d.add_argument("--iterations", type=int, default=10)
     d.add_argument("--batch", type=int, default=8)
