@@ -243,6 +243,41 @@ class HdrfClient:
             _M.incr("files_written")
             _M.incr("bytes_written", len(data))
 
+    def append(self, path: str, data: bytes) -> None:
+        """Append to a complete file (DFSClient.append analog).  The last
+        partial block is REWRITTEN under a bumped generation stamp
+        (block-granular copy-on-append — the design that stays coherent
+        with reduced storage; the re-reduction dedups against the block's
+        own old chunks), full blocks are appended as usual."""
+        if not data:
+            return
+        with _TR.span("append") as sp:
+            sp.annotate("path", path)
+            info = self._call("append", path=path, client=self.name)
+            block_size = info["block_size"]
+            lengths: dict[int, int] = {}
+            last = info.get("last_block")
+            if last is not None:
+                # prefix = the partial last block's current bytes
+                prefix = self.read(path, offset=info["file_length"]
+                                   - last["length"], length=last["length"])
+                merged = prefix + data[:block_size - last["length"]]
+                alloc = self._call("append_block", path=path,
+                                   client=self.name)
+                self._stream_block(alloc, merged)
+                lengths[alloc["block_id"]] = len(merged)
+                data = data[block_size - last["length"]:]
+            off = 0
+            while off < len(data):
+                block = data[off:off + block_size]
+                lengths[self._write_block(path, block)] = len(block)
+                off += block_size
+            self._complete(path, lengths)
+            _M.incr("appends")
+
+    def truncate(self, path: str, new_length: int) -> bool:
+        return self._call("truncate", path=path, new_length=new_length)
+
     def _complete(self, path: str, lengths: dict[int, int],
                   timeout: float = 30.0) -> None:
         """completeFile retry loop: the NN answers False until every block

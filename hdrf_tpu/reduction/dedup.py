@@ -56,6 +56,12 @@ def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
         hashes.append(h)
         if h not in first_range:
             first_range[h] = (int(starts[i]), int(cuts[i] - starts[i]))
+    if index.get_block(block_id) is not None:
+        # Supersede (append rewrote the block under a new gen stamp):
+        # release the old entry's chunk refs before committing the new one —
+        # CDC makes the rewrite dedup against its own old chunks, so the
+        # released refs are mostly re-taken by the commit below.
+        index.delete_block(block_id)
     known = index.lookup_chunks(list(first_range))
     new_hashes = [h for h, loc in known.items() if loc is None]
     chunk_bytes = [mv[o:o + ln] for o, ln in

@@ -115,6 +115,23 @@ class BlockReceiver:
                         dn.notify_block_received(block_id, meta.logical_len)
                         dt.send_ack(sock, seqno, status)
                         _M.incr("blocks_received_direct")
+            except (ConnectionError, OSError, IOError):
+                # Pipeline died mid-stream (client/upstream crash): persist
+                # the acked prefix as a partial replica instead of dropping
+                # it — the RBW-persistence behavior lease recovery's length
+                # sync depends on (BlockRecoveryWorker syncs the MINIMUM
+                # replica length across the pipeline; a dropped prefix here
+                # would silently shrink that to zero).  Every buffered packet
+                # passed its CRC, so the prefix is a safe sync candidate.
+                if writer is not None and writer.bytes_written > 0:
+                    if tail:
+                        crcs.append(native.crc32c(tail))
+                    meta = writer.finalize(writer.bytes_written, "direct",
+                                           crcs, cchunk)
+                    writer = None
+                    dn.notify_block_received(block_id, meta.logical_len)
+                    _M.incr("partial_replicas_persisted")
+                raise
             finally:
                 if writer is not None:
                     writer.abort()

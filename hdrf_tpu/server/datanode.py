@@ -35,7 +35,7 @@ from hdrf_tpu.config import DataNodeConfig
 from hdrf_tpu.index.chunk_index import ChunkIndex
 from hdrf_tpu.ops import dispatch as ops_dispatch
 from hdrf_tpu.proto import datatransfer as dt
-from hdrf_tpu.proto.rpc import RpcClient
+from hdrf_tpu.proto.rpc import RpcClient, send_frame
 from hdrf_tpu.reduction import scheme as schemes
 from hdrf_tpu.reduction.scheme import ReductionContext, ReductionScheme
 from hdrf_tpu.server.block_receiver import BlockReceiver
@@ -267,6 +267,16 @@ class DataNode:
                 self._sender.serve_read(sock, fields)
             elif op == dt.BLOCK_CHECKSUM:
                 self._serve_checksum(sock, fields)
+            elif op == "replica_info":
+                self.tokens.verify(fields.get("token"), fields["block_id"], "r")
+                meta = self.replicas.get_meta(fields["block_id"])
+                send_frame(sock, {"length": meta.logical_len if meta else -1,
+                                  "gen_stamp": meta.gen_stamp if meta else -1})
+            elif op == "truncate_replica":
+                self.tokens.verify(fields.get("token"), fields["block_id"], "w")
+                ok = self.replicas.truncate_replica(fields["block_id"],
+                                                    fields["length"])
+                send_frame(sock, {"ok": ok})
             else:
                 _M.incr("unknown_ops")
         except PermissionError:
@@ -369,6 +379,73 @@ class DataNode:
             self._replicate(cmd)
         elif cmd["cmd"] == "ec_reconstruct":
             self._ec_reconstruct(cmd)
+        elif cmd["cmd"] == "recover_block":
+            self._recover_block(cmd)
+
+    def _peer_call(self, addr, op: str, **fields) -> dict:
+        """One-shot framed request to a peer DN's xceiver (recovery ops)."""
+        import socket as _socket
+
+        from hdrf_tpu.proto.rpc import recv_frame
+
+        s = _socket.create_connection(tuple(addr), timeout=10)
+        try:
+            s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            s = dt.secure_socket(s, fields.get("token"),
+                                 self.config.encrypt_data_transfer)
+            dt.send_op(s, op, **fields)
+            return recv_frame(s)
+        finally:
+            s.close()
+
+    def _recover_block(self, cmd: dict) -> None:
+        """Primary-DN block recovery (BlockRecoveryWorker analog): collect
+        replica lengths from every holder, sync everyone to the MINIMUM
+        (every byte below it was CRC-verified on each node; bytes above it
+        may be missing somewhere), then report the synced length to the NN
+        (commitBlockSynchronization)."""
+        bid = cmd["block_id"]
+        token = self.tokens.mint(bid, "w")
+        lengths: dict[str, int] = {}
+        peers = {p["dn_id"]: p for p in cmd["peers"]}
+        for dn_id, peer in peers.items():
+            try:
+                if dn_id == self.dn_id:
+                    meta = self.replicas.get_meta(bid)
+                    r = {"length": meta.logical_len if meta else -1}
+                else:
+                    r = self._peer_call(tuple(peer["addr"]), "replica_info",
+                                        block_id=bid, token=token)
+                if r.get("length", -1) >= 0:
+                    lengths[dn_id] = r["length"]
+            except (OSError, ConnectionError, IOError):
+                continue
+        new_len = min(lengths.values()) if lengths else 0
+        synced = []
+        for dn_id in lengths:
+            try:
+                if dn_id == self.dn_id:
+                    ok = self.replicas.truncate_replica(bid, new_len)
+                else:
+                    ok = self._peer_call(tuple(peers[dn_id]["addr"]),
+                                         "truncate_replica", block_id=bid,
+                                         length=new_len,
+                                         token=token).get("ok", False)
+                if ok:
+                    synced.append(dn_id)
+            except (OSError, ConnectionError, IOError):
+                continue
+        from hdrf_tpu.proto.rpc import RpcError
+
+        for nn in self._nns:
+            try:
+                nn.call("commit_block_sync", path=cmd["path"], block_id=bid,
+                        length=new_len if synced else 0, dn_ids=synced)
+                _M.incr("blocks_recovered")
+                return
+            except (OSError, ConnectionError, RpcError):
+                continue  # standby / raced recovery: another NN may accept
+        _M.incr("block_recovery_failures")
 
     def _invalidate(self, block_id: int) -> None:
         meta = self.replicas.get_meta(block_id)

@@ -164,6 +164,7 @@ class NameNode:
         self._safemode_auto = False
         self._events_trimmed = 0        # events up to this seq were dropped
         self._pending_space: dict[str, int] = {}   # quota root -> charged bytes
+        self._pending_recovery: dict[int, float] = {}  # bid -> retry deadline
         # Snapshots: frozen subtree images per snapshottable dir
         # (namenode/snapshot analog; blocks are immutable once complete, so a
         # structural freeze IS a consistent point-in-time view).
@@ -353,6 +354,37 @@ class NameNode:
                 node.blocks.remove(bid)
             self._blocks.pop(bid, None)
             self._uncharge_alloc(bid)
+        elif op == "append":
+            node = self._file(rec[1])
+            node.complete = False
+            node.mtime = rec[2]
+        elif op == "bump_block":
+            _, path, bid, gs = rec
+            info = self._blocks[bid]
+            info.gen_stamp = gs
+            info.length = -1        # being rewritten; synced at complete
+            self._gen_stamp = max(self._gen_stamp, gs + 1)
+        elif op == "truncate":
+            _, path, new_len, mtime = rec
+            node = self._file(path)
+            node.mtime = mtime
+            pos = 0
+            keep: list[int] = []
+            for bid in node.blocks:
+                info = self._blocks[bid]
+                ln = max(info.length, 0)
+                if pos >= new_len:
+                    # dropping the BlockInfo orphans the replicas; the next
+                    # block report invalidates them (deleted-file path)
+                    self._blocks.pop(bid, None)
+                    self._uncharge_alloc(bid)
+                    continue
+                if pos + ln > new_len:
+                    info.length = new_len - pos
+                    self._account_length(path, info.length - ln)
+                keep.append(bid)
+                pos += ln
+            node.blocks = keep
         elif op == "complete":
             _, path, lengths, mtime = rec
             node = self._file(path)
@@ -910,6 +942,79 @@ class NameNode:
                                            "addr": list(t.addr)}}
                                for b, t in zip(bids, targets)]}
 
+    def rpc_append(self, path: str, client: str) -> dict:
+        """Reopen a complete file for appending (FSNamesystem.appendFile
+        analog).  The file's last partial block is rewritten by the client
+        under a bumped generation stamp (block-granular copy-on-append —
+        the clean fit for reduced storage, where in-place mutation of a
+        deduplicated block has no meaning; CDC makes the re-reduction of
+        the rewritten block dedup against its own old chunks)."""
+        with self._lock:
+            node = self._file(path)
+            if not node.complete:
+                raise IOError(f"{path} is already open for writing")
+            if node.ec:
+                raise IOError("append to EC files is not supported "
+                              "(matches the reference)")
+            self._leases.check_available(path, client)
+            self._log(["append", path, time.time()])
+            self._leases.acquire(path, client)
+            last = None
+            if node.blocks:
+                info = self._blocks[node.blocks[-1]]
+                if 0 < info.length < self.config.block_size:
+                    last = {"block_id": info.block_id,
+                            "gen_stamp": info.gen_stamp,
+                            "length": info.length}
+            _M.incr("appends")
+            return {"block_size": self.config.block_size, "last_block": last,
+                    "file_length": self._file_len(node)}
+
+    def rpc_append_block(self, path: str, client: str) -> dict:
+        """Targets + bumped gen stamp for rewriting the last partial block.
+        Old-generation replicas are superseded: block reports carrying a
+        stale gen stamp are invalidated (the reference's gen-stamp
+        supersede after pipeline recovery)."""
+        with self._lock:
+            self._leases.check(path, client)
+            node = self._file(path)
+            bid = node.blocks[-1]
+            info = self._blocks[bid]
+            new_gs = self._gen_stamp + 1
+            targets = self._choose_targets(node.replication, exclude=set())
+            if not targets:
+                raise IOError("no datanodes available")
+            self._log(["bump_block", path, bid, new_gs])
+            return {"block_id": bid, "gen_stamp": new_gs,
+                    "scheme": node.scheme,
+                    "token": (self._tokens.mint(bid, "w")
+                              if self._tokens else None),
+                    "targets": [{"dn_id": d.dn_id, "addr": list(d.addr)}
+                                for d in targets]}
+
+    def rpc_truncate(self, path: str, new_length: int) -> bool:
+        """Namespace-level truncate (FSNamesystem.truncate analog): whole
+        blocks beyond the cut are dropped (their replicas invalidate like a
+        delete), and the boundary block's logical length is reduced — reads
+        clamp to it, so no replica rewrite is needed; the surplus physical
+        bytes are reclaimed when the block is next copied (re-replication /
+        balancer), the same deferred-trim the reference's truncate recovery
+        performs."""
+        with self._lock:
+            node = self._file(path)
+            if not node.complete:
+                raise IOError(f"{path} is open for writing")
+            if node.ec:
+                raise IOError("truncate of EC files is not supported")
+            cur = self._file_len(node)
+            if new_length > cur:
+                raise ValueError(f"truncate to {new_length} > length {cur}")
+            if new_length == cur:
+                return True
+            self._log(["truncate", path, new_length, time.time()])
+            _M.incr("truncates")
+            return True
+
     def rpc_abandon_block(self, path: str, client: str, block_id: int) -> bool:
         with self._lock:
             self._leases.check(path, client)
@@ -947,13 +1052,7 @@ class NameNode:
             self._leases.drop("/" + "/".join(self._parts(path)))
             self._leases.drop(path)
             if not node.complete:
-                lengths = {b: max(self._blocks[b].length, 0)
-                           for b in node.blocks if b in self._blocks}
-                if node.ec:
-                    lengths = {g: max(self._groups[g].logical_len, 0)
-                               for g in node.blocks if g in self._groups}
-                self._log(["complete", path, lengths, time.time()])
-                _M.incr("leases_recovered")
+                self._finalize_abandoned(path, node)
             return self._file(path).complete
 
     def rpc_renew_lease(self, client: str) -> bool:
@@ -1213,13 +1312,17 @@ class NameNode:
             for bid, gs, length in blocks:
                 reported.add(bid)
                 info = self._blocks.get(bid)
-                if info is None:
-                    # replica for a deleted file: tell DN to drop it (only
-                    # the active may command — a lagging standby would
-                    # invalidate replicas it just hasn't heard about yet)
+                if info is None or gs < info.gen_stamp:
+                    # replica for a deleted file, or a stale generation left
+                    # behind by an append/recovery supersede: tell the DN to
+                    # drop it (only the active may command — a lagging
+                    # standby would invalidate replicas it just hasn't
+                    # heard about yet)
                     if self.role == "active":
                         dn.commands.append({"cmd": "invalidate",
                                             "block_ids": [bid]})
+                    if info is not None:
+                        reported.discard(bid)
                     continue
                 info.locations.add(dn_id)
                 if info.length < 0:
@@ -1595,8 +1698,8 @@ class NameNode:
     _AUTH_EXEMPT = frozenset({
         "register_datanode", "heartbeat", "block_report",
         "incremental_block_report", "bad_block", "block_received",
-        "ha_state", "transition_to_active", "fetch_image",
-        "get_delegation_token", "renew_delegation_token",
+        "commit_block_sync", "ha_state", "transition_to_active",
+        "fetch_image", "get_delegation_token", "renew_delegation_token",
         "cancel_delegation_token",
     })
 
@@ -1914,11 +2017,62 @@ class NameNode:
                 self._leases.drop(path)
                 node = self._try_file(path)
                 if node is not None and not node.complete:
-                    # finalize with whatever lengths block reports gave us
-                    lengths = {b: max(self._blocks[b].length, 0)
-                               for b in node.blocks if b in self._blocks}
-                    self._log(["complete", path, lengths, time.time()])
-                    _M.incr("leases_recovered")
+                    self._finalize_abandoned(path, node)
+
+    def _finalize_abandoned(self, path: str, node: "FileNode") -> bool:
+        """Close a writer-abandoned file.  If the last block's length is
+        unresolved and replicas exist, dispatch a primary-DN length-sync
+        recovery first (BlockRecoveryWorker; the pipeline may have died with
+        different replica lengths on each node) and finish in
+        rpc_commit_block_sync; otherwise complete with known lengths.
+        Returns True when the file closed now.  Caller holds the lock."""
+        last = node.blocks[-1] if node.blocks and not node.ec else None
+        info = self._blocks.get(last) if last is not None else None
+        live = (sorted(info.locations & set(self._datanodes))
+                if info is not None else [])
+        if info is not None and info.length < 0 and live:
+            now = time.monotonic()
+            if now < self._pending_recovery.get(last, 0):
+                return False  # a recovery is already in flight
+            self._pending_recovery[last] = now + 30.0
+            primary = self._datanodes[live[0]]
+            primary.commands.append({
+                "cmd": "recover_block", "path": path, "block_id": last,
+                "gen_stamp": info.gen_stamp,
+                "peers": [{"dn_id": d, "addr": list(self._datanodes[d].addr)}
+                          for d in live]})
+            _M.incr("block_recoveries_dispatched")
+            return False
+        lengths = {b: max(self._blocks[b].length, 0)
+                   for b in node.blocks if b in self._blocks}
+        if node.ec:
+            lengths = {g: max(self._groups[g].logical_len, 0)
+                       for g in node.blocks if g in self._groups}
+        self._log(["complete", path, lengths, time.time()])
+        _M.incr("leases_recovered")
+        return True
+
+    def rpc_commit_block_sync(self, path: str, block_id: int, length: int,
+                              dn_ids: list) -> bool:
+        """Primary-DN report after a length-sync recovery
+        (commitBlockSynchronization analog): record the agreed length (or
+        drop a block no replica survived for) and close the file."""
+        with self._lock:
+            self._pending_recovery.pop(block_id, None)
+            node = self._try_file(path)
+            info = self._blocks.get(block_id)
+            if node is None or node.complete or info is None:
+                return False
+            if length <= 0:
+                self._log(["abandon_block", path, block_id])
+            else:
+                info.locations &= set(dn_ids)
+            lengths = {b: (length if b == block_id
+                           else max(self._blocks[b].length, 0))
+                       for b in node.blocks if b in self._blocks}
+            self._log(["complete", path, lengths, time.time()])
+            _M.incr("blocks_synced")
+            return True
 
     def _try_file(self, path: str) -> FileNode | None:
         try:

@@ -144,8 +144,12 @@ class ReplicaStore:
 
     def create_rbw(self, block_id: int, gen_stamp: int = 0) -> ReplicaWriter:
         with self._lock:
-            if block_id in self._replicas:
+            existing = self._replicas.get(block_id)
+            if existing is not None and gen_stamp <= existing.gen_stamp:
                 raise FileExistsError(f"block {block_id} already finalized")
+            # gen_stamp > existing: a supersede rewrite (append / recovery) —
+            # the old replica keeps serving reads until finalize replaces it
+            # atomically (the RBW writes to the rbw/ path, os.replace swaps)
             if block_id in self._rbw:
                 raise FileExistsError(f"block {block_id} already being written")
             self._rbw.add(block_id)
@@ -186,6 +190,41 @@ class ReplicaStore:
 
     def data_path(self, block_id: int) -> str:
         return self._path(FINALIZED, block_id)
+
+    def truncate_replica(self, block_id: int, new_len: int) -> bool:
+        """Cut a DIRECT replica to ``new_len`` logical bytes (the
+        BlockRecoveryWorker length-sync truncation).  Reduced replicas are
+        all-or-nothing — a committed reduced block never has a divergent
+        length, so only equal-length no-ops are legal there."""
+        with self._lock:
+            meta = self._replicas.get(block_id)
+            if meta is None:
+                return False
+            if meta.logical_len <= new_len:
+                return True
+            if meta.scheme != "direct":
+                raise IOError(f"block {block_id}: cannot truncate a "
+                              f"{meta.scheme} replica to {new_len}")
+            p = self._path(FINALIZED, block_id)
+            with open(p, "r+b") as f:
+                f.truncate(new_len)
+                f.flush()
+                os.fsync(f.fileno())
+            nchunks = -(-new_len // meta.checksum_chunk) if new_len else 0
+            meta.logical_len = meta.physical_len = new_len
+            del meta.checksums[nchunks:]
+            if new_len % meta.checksum_chunk and meta.checksums:
+                # re-derive the now-partial final chunk's checksum
+                with open(p, "rb") as f:
+                    f.seek((nchunks - 1) * meta.checksum_chunk)
+                    from hdrf_tpu import native
+                    meta.checksums[-1] = native.crc32c(f.read())
+            with open(p + ".meta", "wb") as f:
+                f.write(meta.pack())
+                f.flush()
+                os.fsync(f.fileno())
+            _M.incr("replicas_truncated")
+            return True
 
     def delete(self, block_id: int) -> None:
         with self._lock:
