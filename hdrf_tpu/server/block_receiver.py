@@ -112,7 +112,8 @@ class BlockReceiver:
                         meta = writer.finalize(writer.bytes_written, "direct",
                                                crcs, cchunk)
                         writer = None
-                        dn.notify_block_received(block_id, meta.logical_len)
+                        dn.notify_block_received(block_id, meta.logical_len,
+                                                 meta.gen_stamp)
                         dt.send_ack(sock, seqno, status)
                         _M.incr("blocks_received_direct")
             except (ConnectionError, OSError, IOError):
@@ -129,7 +130,8 @@ class BlockReceiver:
                     meta = writer.finalize(writer.bytes_written, "direct",
                                            crcs, cchunk)
                     writer = None
-                    dn.notify_block_received(block_id, meta.logical_len)
+                    dn.notify_block_received(block_id, meta.logical_len,
+                                             meta.gen_stamp)
                     _M.incr("partial_replicas_persisted")
                 raise
             finally:
@@ -180,7 +182,7 @@ class BlockReceiver:
         except Exception:
             writer.abort()
             raise
-        dn.notify_block_received(block_id, meta.logical_len)
+        dn.notify_block_received(block_id, meta.logical_len, meta.gen_stamp)
         status = dt.ACK_SUCCESS
         if targets:
             try:
@@ -282,7 +284,7 @@ class BlockReceiver:
         except Exception:
             writer.abort()
             raise
-        dn.notify_block_received(block_id, meta.logical_len)
+        dn.notify_block_received(block_id, meta.logical_len, meta.gen_stamp)
         status = dt.ACK_SUCCESS
         if targets:  # relay down the chain
             try:

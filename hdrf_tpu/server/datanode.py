@@ -203,12 +203,15 @@ class DataNode:
         finally:
             self._read_sem.release()
 
-    def notify_block_received(self, block_id: int, length: int) -> None:
+    def notify_block_received(self, block_id: int, length: int,
+                              gen_stamp: int = -1) -> None:
         """Incremental block report (IBR) on finalize: queued and delivered
         by a dedicated thread so an unreachable NN can never stall the write
         pipeline's ack (HDFS IBRs are asynchronous for the same reason);
-        best-effort — the periodic full report reconciles anything missed."""
-        self._ibr_queue.append((block_id, length))
+        best-effort — the periodic full report reconciles anything missed.
+        Carries the replica's gen stamp so the NN can fence a superseded
+        pipeline's late finalize."""
+        self._ibr_queue.append((block_id, length, gen_stamp))
         self._ibr_event.set()
 
     def _ibr_loop(self) -> None:
@@ -216,11 +219,12 @@ class DataNode:
             self._ibr_event.wait(timeout=0.5)
             self._ibr_event.clear()
             while self._ibr_queue:
-                block_id, length = self._ibr_queue.pop(0)
+                block_id, length, gen_stamp = self._ibr_queue.pop(0)
                 for nn in self._nns:
                     try:
                         nn.call("block_received", dn_id=self.dn_id,
-                                block_id=block_id, length=length)
+                                block_id=block_id, length=length,
+                                gen_stamp=gen_stamp)
                     except (OSError, ConnectionError):
                         _M.incr("ibr_failures")
 
@@ -274,8 +278,9 @@ class DataNode:
                                   "gen_stamp": meta.gen_stamp if meta else -1})
             elif op == "truncate_replica":
                 self.tokens.verify(fields.get("token"), fields["block_id"], "w")
-                ok = self.replicas.truncate_replica(fields["block_id"],
-                                                    fields["length"])
+                ok = self.replicas.truncate_replica(
+                    fields["block_id"], fields["length"],
+                    new_gs=fields.get("new_gen_stamp"))
                 send_frame(sock, {"ok": ok})
             else:
                 _M.incr("unknown_ops")
@@ -400,36 +405,48 @@ class DataNode:
 
     def _recover_block(self, cmd: dict) -> None:
         """Primary-DN block recovery (BlockRecoveryWorker analog): collect
-        replica lengths from every holder, sync everyone to the MINIMUM
-        (every byte below it was CRC-verified on each node; bytes above it
-        may be missing somewhere), then report the synced length to the NN
+        replica (gen_stamp, length) from every holder, keep the replicas of
+        the HIGHEST generation, sync those to the MINIMUM length (every byte
+        below it was CRC-verified on each node; bytes above it may be
+        missing somewhere), restamp survivors with the recovery gen stamp
+        from the NN (so the next full block report doesn't invalidate
+        them), then report the synced length to the NN
         (commitBlockSynchronization)."""
         bid = cmd["block_id"]
+        rec_gs = cmd["gen_stamp"]
         token = self.tokens.mint(bid, "w")
-        lengths: dict[str, int] = {}
+        infos: dict[str, tuple[int, int]] = {}  # dn_id -> (gs, length)
         peers = {p["dn_id"]: p for p in cmd["peers"]}
         for dn_id, peer in peers.items():
             try:
                 if dn_id == self.dn_id:
                     meta = self.replicas.get_meta(bid)
-                    r = {"length": meta.logical_len if meta else -1}
+                    r = {"length": meta.logical_len if meta else -1,
+                         "gen_stamp": meta.gen_stamp if meta else -1}
                 else:
                     r = self._peer_call(tuple(peer["addr"]), "replica_info",
                                         block_id=bid, token=token)
                 if r.get("length", -1) >= 0:
-                    lengths[dn_id] = r["length"]
+                    infos[dn_id] = (r.get("gen_stamp", 0), r["length"])
             except (OSError, ConnectionError, IOError):
                 continue
-        new_len = min(lengths.values()) if lengths else 0
+        if infos:
+            top = max(gs for gs, _ in infos.values())
+            cand = {d: ln for d, (gs, ln) in infos.items() if gs == top}
+            new_len = min(cand.values())
+        else:
+            cand, new_len = {}, 0
         synced = []
-        for dn_id in lengths:
+        for dn_id in cand:
             try:
                 if dn_id == self.dn_id:
-                    ok = self.replicas.truncate_replica(bid, new_len)
+                    ok = self.replicas.truncate_replica(bid, new_len,
+                                                        new_gs=rec_gs)
                 else:
                     ok = self._peer_call(tuple(peers[dn_id]["addr"]),
                                          "truncate_replica", block_id=bid,
                                          length=new_len,
+                                         new_gen_stamp=rec_gs,
                                          token=token).get("ok", False)
                 if ok:
                     synced.append(dn_id)
@@ -440,7 +457,8 @@ class DataNode:
         for nn in self._nns:
             try:
                 nn.call("commit_block_sync", path=cmd["path"], block_id=bid,
-                        length=new_len if synced else 0, dn_ids=synced)
+                        length=new_len if synced else 0, dn_ids=synced,
+                        gen_stamp=rec_gs)
                 _M.incr("blocks_recovered")
                 return
             except (OSError, ConnectionError, RpcError):
@@ -508,7 +526,8 @@ class DataNode:
         except Exception:
             writer.abort()
             raise
-        self.notify_block_received(cmd["block_id"], meta.logical_len)
+        self.notify_block_received(cmd["block_id"], meta.logical_len,
+                                   meta.gen_stamp)
         _M.incr("ec_blocks_reconstructed")
 
     # ------------------------------------------------------------ inspection

@@ -582,16 +582,40 @@ class QuorumJournal:
         oks = {a: r for a, r in rs.items() if isinstance(r, dict)}
         if len(oks) < self._majority:
             raise QuorumLostError(f"{len(oks)}/{self._n} journal nodes up")
-        lasts = sorted((r["last_seq"] for r in oks.values()), reverse=True)
+        # Only nodes on the NEWEST write-epoch lineage are trustworthy: a
+        # node that was down through epoch recovery can rejoin holding
+        # divergent dead-epoch records at the same seqs (its tail is only
+        # truncated by the writer's next overlapping append).  Counting its
+        # last_seq toward the floor — or reading from it — would let a
+        # standby apply uncommitted records that contradict what the active
+        # acked.  Epochs are monotone, so max(wepoch) identifies the canon.
+        wmax = max(r["wepoch"] for r in oks.values())
+        canon = {a: r for a, r in oks.items() if r["wepoch"] == wmax}
         if readonly:
+            if len(canon) < self._majority:
+                # can't certify a committed floor from this view (e.g. a
+                # brand-new epoch caught up only a minority before we
+                # polled): make no progress this tick rather than risk
+                # applying an uncommitted record
+                return []
+            lasts = sorted((r["last_seq"] for r in canon.values()),
+                           reverse=True)
             floor = lasts[self._majority - 1]
         else:
             assert self._epoch is not None, "writer read before claim_epoch"
             floor = self._recovered_hi
         out: list[bytes] = []
-        src = max(((a, r) for a, r in oks.items()
-                   if r["last_seq"] >= floor),
-                  key=lambda kv: kv[1]["last_seq"])[0]
+        cands = [(a, r) for a, r in canon.items() if r["last_seq"] >= floor]
+        if not cands:
+            # writer path only (readonly floors come FROM canon): a newer
+            # claimant's write epoch appeared and none of its nodes cover
+            # our recovered range — we are superseded, not merely degraded
+            if not readonly and self._epoch is not None \
+                    and wmax > self._epoch:
+                raise FencedError(
+                    f"epoch {self._epoch} superseded by write epoch {wmax}")
+            raise QuorumLostError("no journal node holds the committed range")
+        src = max(cands, key=lambda kv: kv[1]["last_seq"])[0]
         after = after_seq
         while after < floor:
             r = self._call(src, "jn_read", after_seq=after)

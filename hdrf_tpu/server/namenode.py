@@ -54,7 +54,15 @@ class BlockInfo:
     gen_stamp: int
     length: int  # logical; -1 until the client reports it at complete()
     path: str
+    # serving replicas: DNs holding the CURRENT generation
     locations: set[str] = field(default_factory=set)  # dn_ids
+    # every live replica ever reported, any generation: dn_id ->
+    # (gen_stamp, length).  This is what lease recovery consults — an IBR
+    # must never fix a UC block's length (first-reporter-wins would violate
+    # the min-CRC-verified-prefix invariant), and a stale-generation replica
+    # that is the block's only copy must never be destroyed (the reference's
+    # commitBlockSynchronization restamps it instead).
+    reported: dict[str, tuple[int, int]] = field(default_factory=dict)
 
 
 @dataclass
@@ -122,6 +130,15 @@ class LeaseManager:
         now = time.monotonic()
         return [p for p, (_, dl) in self._leases.items() if dl <= now]
 
+    def force_expire(self, path: str) -> None:
+        """Mark ``path``'s lease expired NOW (recoverLease): the recovery
+        monitor keeps retrying finalization each tick until the file closes,
+        while an expired lease no longer blocks other writers.  Inserts a
+        placeholder when no lease exists so an abandoned file can't get
+        stuck open with nothing driving its recovery."""
+        holder = self._leases.get(path)
+        self._leases[path] = ((holder[0] if holder else "<recovery>"), 0.0)
+
     def drop(self, path: str) -> None:
         self._leases.pop(path, None)
 
@@ -165,6 +182,7 @@ class NameNode:
         self._events_trimmed = 0        # events up to this seq were dropped
         self._pending_space: dict[str, int] = {}   # quota root -> charged bytes
         self._pending_recovery: dict[int, float] = {}  # bid -> retry deadline
+        self._recovery_grace: dict[int, float] = {}    # bid -> IBR-wait deadline
         # Snapshots: frozen subtree images per snapshottable dir
         # (namenode/snapshot analog; blocks are immutable once complete, so a
         # structural freeze IS a consistent point-in-time view).
@@ -363,6 +381,11 @@ class NameNode:
             info = self._blocks[bid]
             info.gen_stamp = gs
             info.length = -1        # being rewritten; synced at complete
+            # the new-generation pipeline repopulates locations via IBRs;
+            # leaving the old-generation holders here would hand readers
+            # stale bytes right after an append (they stay in `reported`
+            # until the post-supersede block report invalidates them)
+            info.locations.clear()
             self._gen_stamp = max(self._gen_stamp, gs + 1)
         elif op == "truncate":
             _, path, new_len, mtime = rec
@@ -381,7 +404,6 @@ class NameNode:
                     continue
                 if pos + ln > new_len:
                     info.length = new_len - pos
-                    self._account_length(path, info.length - ln)
                 keep.append(bid)
                 pos += ln
             node.blocks = keep
@@ -459,7 +481,10 @@ class NameNode:
                 u = self._qusage.get(r)
                 if u is not None:
                     u[1] += add
-        elif op in ("delete", "rename", "delete_snapshot"):
+        elif op in ("delete", "rename", "delete_snapshot", "truncate"):
+            # truncate included: it SHRINKS usage (dropped whole blocks +
+            # the cut boundary block), which the incremental paths never
+            # subtract — a stale high value would falsely reject writes
             for path in (rec[1], rec[2] if op == "rename" else rec[1]):
                 if isinstance(path, str):
                     for r, _ in self._quota_roots_of(path):
@@ -1044,16 +1069,22 @@ class NameNode:
 
     def rpc_recover_lease(self, path: str) -> bool:
         """Force lease recovery on ``path`` (DFSAdmin recoverLease /
-        DistributedFileSystem.recoverLease analog): drop the writer's lease
-        and finalize the file with the block lengths reports gave us.
-        Returns True when the file is closed afterwards."""
+        DistributedFileSystem.recoverLease analog).  The writer's lease is
+        force-expired — NOT dropped — so the recovery monitor keeps driving
+        the (asynchronous, possibly multi-step) recovery even if the caller
+        stops polling.  Returns True when the file is closed afterwards."""
         with self._lock:
             node = self._file(path)
-            self._leases.drop("/" + "/".join(self._parts(path)))
-            self._leases.drop(path)
-            if not node.complete:
-                self._finalize_abandoned(path, node)
-            return self._file(path).complete
+            p = "/" + "/".join(self._parts(path))
+            self._leases.drop(path)  # un-normalized alias, if any
+            if node.complete:
+                self._leases.drop(p)
+                return True
+            self._leases.force_expire(p)
+            if self._finalize_abandoned(p, node):
+                self._leases.drop(p)
+                return True
+            return False
 
     def rpc_renew_lease(self, client: str) -> bool:
         with self._lock:
@@ -1310,34 +1341,80 @@ class NameNode:
                 raise KeyError(f"unregistered datanode {dn_id}")
             reported = set()
             for bid, gs, length in blocks:
-                reported.add(bid)
                 info = self._blocks.get(bid)
-                if info is None or gs < info.gen_stamp:
-                    # replica for a deleted file, or a stale generation left
-                    # behind by an append/recovery supersede: tell the DN to
-                    # drop it (only the active may command — a lagging
-                    # standby would invalidate replicas it just hasn't
-                    # heard about yet)
+                if info is None:
+                    # replica for a deleted file: drop it (only the active
+                    # may command — a lagging standby would invalidate
+                    # replicas it just hasn't heard about yet)
                     if self.role == "active":
                         dn.commands.append({"cmd": "invalidate",
                                             "block_ids": [bid]})
-                    if info is not None:
-                        reported.discard(bid)
                     continue
-                info.locations.add(dn_id)
-                if info.length < 0:
-                    info.length = length
-                    self._account_length(info.path, length)
+                if gs >= info.gen_stamp:
+                    if 0 <= length < info.length:
+                        # a SHORT replica of a completed block cannot serve
+                        # it (corrupt-on-length-mismatch, BlockManager
+                        # semantics).  With healthy copies elsewhere it is
+                        # invalidated outright — left in `reported` it would
+                        # later act as a length candidate in lease recovery
+                        # and min-sync healthy replicas down to it.  Only
+                        # while it is the block's last copy is it preserved.
+                        others = {d for d in info.locations
+                                  if d in self._datanodes} - {dn_id}
+                        if others:
+                            if self.role == "active":
+                                dn.commands.append({"cmd": "invalidate",
+                                                    "block_ids": [bid]})
+                            info.reported.pop(dn_id, None)
+                            info.locations.discard(dn_id)
+                        else:
+                            reported.add(bid)
+                            info.reported[dn_id] = (gs, length)
+                            info.locations.discard(dn_id)
+                        continue
+                    reported.add(bid)
+                    info.reported[dn_id] = (gs, length)
+                    info.locations.add(dn_id)
+                    continue
+                # Stale generation (append/recovery supersede).  NEVER
+                # destroy it while the block is under construction or it is
+                # the only live copy — a client crash right after an append's
+                # bump_block would otherwise let the NN invalidate every
+                # old-generation replica before any new-generation byte
+                # lands (silent data loss); lease recovery restamps the
+                # survivors instead (commitBlockSynchronization semantics).
+                others = {d for d in info.locations
+                          if d in self._datanodes} - {dn_id}
+                if info.length < 0 or not others:
+                    reported.add(bid)
+                    info.reported[dn_id] = (gs, length)
+                    # kept alive but NOT in locations: a stale replica must
+                    # not serve reads of the superseded block
+                    info.locations.discard(dn_id)
+                else:
+                    if self.role == "active":
+                        dn.commands.append({"cmd": "invalidate",
+                                            "block_ids": [bid]})
+                    info.reported.pop(dn_id, None)
+                    info.locations.discard(dn_id)
             for bid in dn.blocks - reported:
                 info = self._blocks.get(bid)
                 if info:
                     info.locations.discard(dn_id)
+                    info.reported.pop(dn_id, None)
             dn.blocks = reported
             _M.incr("block_reports")
             return True
 
-    def rpc_block_received(self, dn_id: str, block_id: int, length: int) -> bool:
-        """Incremental block report on pipeline finalize (IBR analog)."""
+    def rpc_block_received(self, dn_id: str, block_id: int, length: int,
+                           gen_stamp: int = -1) -> bool:
+        """Incremental block report on pipeline finalize (IBR analog).
+
+        An IBR records the replica but never fixes a UC block's length:
+        first-reporter-wins would let the file complete at whatever length
+        that one replica has, violating the min-CRC-verified-prefix
+        invariant lease recovery guarantees — only ``complete`` and
+        ``commit_block_sync`` resolve lengths."""
         with self._lock:
             dn = self._datanodes.get(dn_id)
             info = self._blocks.get(block_id)
@@ -1348,26 +1425,25 @@ class NameNode:
                     # IBR raced ahead of the journal tail: queue it (the
                     # reference's PendingDataNodeMessages on the standby)
                     self._pending_ibr.setdefault(block_id, []).append(
-                        (dn_id, length))
+                        (dn_id, length, gen_stamp))
                     if len(self._pending_ibr) > 100_000:
                         self._pending_ibr.pop(next(iter(self._pending_ibr)))
                 return False
+            if 0 <= gen_stamp < info.gen_stamp:
+                # a superseded pipeline finalizing late (fenced by the
+                # append/recovery gen-stamp bump): keep the bytes visible to
+                # recovery, but never serve the stale generation
+                info.reported[dn_id] = (gen_stamp, length)
+                return False
             dn.blocks.add(block_id)
-            info.locations.add(dn_id)
-            if info.length < 0:
-                info.length = length
-                self._account_length(info.path, length)
+            info.reported[dn_id] = (
+                gen_stamp if gen_stamp >= 0 else info.gen_stamp, length)
+            if 0 <= length < info.length:
+                # short replica of a completed block: cannot serve it
+                info.locations.discard(dn_id)
+            else:
+                info.locations.add(dn_id)
             return True
-
-    def _account_length(self, path: str, add: int) -> None:
-        """An in-flight block's length became known (IBR): cached space usage
-        of enclosing quota roots grows by it."""
-        if not self._quotas or add <= 0:
-            return
-        for r, _ in self._quota_roots_of(path):
-            u = self._qusage.get(r)
-            if u is not None:
-                u[1] += add
 
     def _charge_alloc(self, path: str, bid: int, size: int) -> None:
         """Conservative full-block space charge at allocation time (HDFS does
@@ -1394,15 +1470,19 @@ class NameNode:
     def _drain_pending_ibr(self) -> None:
         """Apply queued IBRs whose blocks the journal tail has now created."""
         for bid in [b for b in self._pending_ibr if b in self._blocks]:
-            for dn_id, length in self._pending_ibr.pop(bid):
+            for dn_id, length, gen_stamp in self._pending_ibr.pop(bid):
                 info = self._blocks[bid]
                 dn = self._datanodes.get(dn_id)
                 if dn is not None:
-                    dn.blocks.add(bid)
-                    info.locations.add(dn_id)
-                    if info.length < 0:
-                        info.length = length
-                        self._account_length(info.path, length)
+                    info.reported[dn_id] = (
+                        gen_stamp if gen_stamp >= 0 else info.gen_stamp,
+                        length)
+                    if not (0 <= gen_stamp < info.gen_stamp):
+                        dn.blocks.add(bid)
+                        # same short-replica guard as rpc_block_received:
+                        # the tailed batch may have completed the block
+                        if not 0 <= length < info.length:
+                            info.locations.add(dn_id)
 
     # ------------------------------------------------------------- admin RPC
 
@@ -1431,6 +1511,7 @@ class NameNode:
             if info is None:
                 return False
             info.locations.discard(dn_id)
+            info.reported.pop(dn_id, None)
             if dn is not None:
                 dn.blocks.discard(block_id)
             self._pending_repl.pop(block_id, None)  # reschedule immediately
@@ -1889,6 +1970,7 @@ class NameNode:
                         info = self._blocks.get(bid)
                         if info:
                             info.locations.discard(dn.dn_id)
+                            info.reported.pop(dn.dn_id, None)
                     del self._datanodes[dn.dn_id]
 
     def _check_replication(self) -> None:
@@ -2014,61 +2096,127 @@ class NameNode:
     def _recover_leases(self) -> None:
         with self._lock:
             for path in self._leases.expired():
-                self._leases.drop(path)
                 node = self._try_file(path)
-                if node is not None and not node.complete:
-                    self._finalize_abandoned(path, node)
+                if node is None or node.complete:
+                    self._leases.drop(path)
+                    continue
+                # keep the (expired) lease until the file actually closes:
+                # it is what makes the monitor retry a finalize that is
+                # waiting on IBR grace or an in-flight block recovery
+                if self._finalize_abandoned(path, node):
+                    self._leases.drop(path)
+
+    RECOVERY_GRACE_S = 4.0  # bounded wait for async IBRs before concluding
+    # "no replica survived" (the reference's recovery also never trusts an
+    # instantaneous empty view — rpc_recover_lease polls race the DNs' IBRs)
+
+    def _resolved_length(self, bid: int) -> int:
+        """Best known logical length of a block: the committed length if
+        resolved, else the MINIMUM length among live replicas of the highest
+        reported generation (every byte below the minimum was CRC-verified
+        on each node — BlockRecoveryWorker's sync rule)."""
+        info = self._blocks.get(bid)
+        if info is None:
+            return 0
+        if info.length >= 0:
+            return info.length
+        live = [v for d, v in info.reported.items() if d in self._datanodes]
+        if not live:
+            return 0
+        top = max(gs for gs, _ in live)
+        return min(ln for gs, ln in live if gs == top)
 
     def _finalize_abandoned(self, path: str, node: "FileNode") -> bool:
         """Close a writer-abandoned file.  If the last block's length is
-        unresolved and replicas exist, dispatch a primary-DN length-sync
-        recovery first (BlockRecoveryWorker; the pipeline may have died with
-        different replica lengths on each node) and finish in
-        rpc_commit_block_sync; otherwise complete with known lengths.
+        unresolved: with live replicas, journal a recovery generation stamp
+        (fencing the dead writer's pipeline) and dispatch a primary-DN
+        length-sync recovery (BlockRecoveryWorker; the pipeline may have
+        died with different replica lengths on each node), finishing in
+        rpc_commit_block_sync; with NO replicas reported yet, wait a bounded
+        grace for the asynchronous IBRs before dropping the block.
         Returns True when the file closed now.  Caller holds the lock."""
         last = node.blocks[-1] if node.blocks and not node.ec else None
         info = self._blocks.get(last) if last is not None else None
-        live = (sorted(info.locations & set(self._datanodes))
-                if info is not None else [])
-        if info is not None and info.length < 0 and live:
+        if info is not None and info.length < 0:
             now = time.monotonic()
-            if now < self._pending_recovery.get(last, 0):
-                return False  # a recovery is already in flight
-            self._pending_recovery[last] = now + 30.0
-            primary = self._datanodes[live[0]]
-            primary.commands.append({
-                "cmd": "recover_block", "path": path, "block_id": last,
-                "gen_stamp": info.gen_stamp,
-                "peers": [{"dn_id": d, "addr": list(self._datanodes[d].addr)}
-                          for d in live]})
-            _M.incr("block_recoveries_dispatched")
-            return False
-        lengths = {b: max(self._blocks[b].length, 0)
-                   for b in node.blocks if b in self._blocks}
+            live = sorted(d for d in info.reported if d in self._datanodes)
+            lens = {v for d, v in info.reported.items()
+                    if d in self._datanodes}
+            if live and len(lens) == 1 and \
+                    next(iter(lens))[0] == info.gen_stamp:
+                # every live replica is at the current generation and they
+                # agree on length: nothing to sync — complete directly (the
+                # all-replicas-consistent fast path of the reference's
+                # internalReleaseLease); _resolved_length picks the agreed
+                # value below
+                self._recovery_grace.pop(last, None)
+            elif live:
+                self._recovery_grace.pop(last, None)
+                if now < self._pending_recovery.get(last, 0):
+                    return False  # a recovery is already in flight
+                # Journal the recovery generation stamp BEFORE dispatching:
+                # it fences the dead writer (a late finalize IBRs as stale)
+                # and survivors are restamped with it so the next full block
+                # report doesn't invalidate the just-recovered replicas
+                # (commitBlockSynchronization installs the recovery gen
+                # stamp in the reference too).
+                rec_gs = self._gen_stamp
+                self._log(["bump_block", path, last, rec_gs])
+                self._pending_recovery[last] = now + 30.0
+                primary = self._datanodes[live[0]]
+                primary.commands.append({
+                    "cmd": "recover_block", "path": path, "block_id": last,
+                    "gen_stamp": rec_gs,
+                    "peers": [{"dn_id": d,
+                               "addr": list(self._datanodes[d].addr)}
+                              for d in live]})
+                _M.incr("block_recoveries_dispatched")
+                return False
+            else:
+                deadline = self._recovery_grace.setdefault(
+                    last, now + self.RECOVERY_GRACE_S)
+                if now < deadline:
+                    return False  # IBRs may still be in flight; retry later
+                # grace expired with no replica reported: nothing survived —
+                # drop the last block (the reference drops it too) and close
+                self._recovery_grace.pop(last, None)
+                self._log(["abandon_block", path, last])
         if node.ec:
             lengths = {g: max(self._groups[g].logical_len, 0)
                        for g in node.blocks if g in self._groups}
+        else:
+            lengths = {b: self._resolved_length(b)
+                       for b in node.blocks if b in self._blocks}
         self._log(["complete", path, lengths, time.time()])
         _M.incr("leases_recovered")
         return True
 
     def rpc_commit_block_sync(self, path: str, block_id: int, length: int,
-                              dn_ids: list) -> bool:
+                              dn_ids: list, gen_stamp: int = -1) -> bool:
         """Primary-DN report after a length-sync recovery
         (commitBlockSynchronization analog): record the agreed length (or
-        drop a block no replica survived for) and close the file."""
+        drop a block no replica survived for), install the recovery
+        generation's replica set as the serving locations, and close the
+        file."""
         with self._lock:
-            self._pending_recovery.pop(block_id, None)
             node = self._try_file(path)
             info = self._blocks.get(block_id)
             if node is None or node.complete or info is None:
                 return False
+            if 0 <= gen_stamp < info.gen_stamp:
+                return False  # a newer recovery superseded this one
+            self._pending_recovery.pop(block_id, None)
+            self._recovery_grace.pop(block_id, None)
             if length <= 0:
                 self._log(["abandon_block", path, block_id])
             else:
-                info.locations &= set(dn_ids)
+                live = set(dn_ids) & set(self._datanodes)
+                info.locations = set(live)
+                for d in live:
+                    info.reported[d] = (info.gen_stamp, length)
+                    self._datanodes[d].blocks.add(block_id)
             lengths = {b: (length if b == block_id
-                           else max(self._blocks[b].length, 0))
+                           else self._resolved_length(b))
                        for b in node.blocks if b in self._blocks}
             self._log(["complete", path, lengths, time.time()])
             _M.incr("blocks_synced")

@@ -191,39 +191,49 @@ class ReplicaStore:
     def data_path(self, block_id: int) -> str:
         return self._path(FINALIZED, block_id)
 
-    def truncate_replica(self, block_id: int, new_len: int) -> bool:
+    def truncate_replica(self, block_id: int, new_len: int,
+                         new_gs: int | None = None) -> bool:
         """Cut a DIRECT replica to ``new_len`` logical bytes (the
         BlockRecoveryWorker length-sync truncation).  Reduced replicas are
         all-or-nothing — a committed reduced block never has a divergent
-        length, so only equal-length no-ops are legal there."""
+        length, so only equal-length no-ops are legal there.  ``new_gs``
+        restamps the replica with the recovery generation stamp (the
+        commitBlockSynchronization restamp: without it the next full block
+        report would present the old generation and the NN would invalidate
+        the just-recovered replica)."""
         with self._lock:
             meta = self._replicas.get(block_id)
             if meta is None:
                 return False
-            if meta.logical_len <= new_len:
-                return True
-            if meta.scheme != "direct":
-                raise IOError(f"block {block_id}: cannot truncate a "
-                              f"{meta.scheme} replica to {new_len}")
-            p = self._path(FINALIZED, block_id)
-            with open(p, "r+b") as f:
-                f.truncate(new_len)
-                f.flush()
-                os.fsync(f.fileno())
-            nchunks = -(-new_len // meta.checksum_chunk) if new_len else 0
-            meta.logical_len = meta.physical_len = new_len
-            del meta.checksums[nchunks:]
-            if new_len % meta.checksum_chunk and meta.checksums:
-                # re-derive the now-partial final chunk's checksum
-                with open(p, "rb") as f:
-                    f.seek((nchunks - 1) * meta.checksum_chunk)
-                    from hdrf_tpu import native
-                    meta.checksums[-1] = native.crc32c(f.read())
-            with open(p + ".meta", "wb") as f:
+            if meta.logical_len <= new_len and \
+                    (new_gs is None or new_gs <= meta.gen_stamp):
+                return True  # nothing to cut or restamp (recovery retry)
+            if meta.logical_len > new_len:
+                if meta.scheme != "direct":
+                    raise IOError(f"block {block_id}: cannot truncate a "
+                                  f"{meta.scheme} replica to {new_len}")
+                p = self._path(FINALIZED, block_id)
+                with open(p, "r+b") as f:
+                    f.truncate(new_len)
+                    f.flush()
+                    os.fsync(f.fileno())
+                nchunks = -(-new_len // meta.checksum_chunk) if new_len else 0
+                meta.logical_len = meta.physical_len = new_len
+                del meta.checksums[nchunks:]
+                if new_len % meta.checksum_chunk and meta.checksums:
+                    # re-derive the now-partial final chunk's checksum
+                    with open(p, "rb") as f:
+                        f.seek((nchunks - 1) * meta.checksum_chunk)
+                        from hdrf_tpu import native
+                        meta.checksums[-1] = native.crc32c(f.read())
+                _M.incr("replicas_truncated")
+            if new_gs is not None and new_gs > meta.gen_stamp:
+                meta.gen_stamp = new_gs
+            mp = self._path(FINALIZED, block_id) + ".meta"
+            with open(mp, "wb") as f:
                 f.write(meta.pack())
                 f.flush()
                 os.fsync(f.fileno())
-            _M.incr("replicas_truncated")
             return True
 
     def delete(self, block_id: int) -> None:

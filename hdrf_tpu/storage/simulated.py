@@ -88,6 +88,31 @@ class SimulatedReplicaStore:
         raise OSError("simulated dataset has no on-disk paths "
                       "(short-circuit reads are disabled)")
 
+    def truncate_replica(self, block_id: int, new_len: int,
+                         new_gs: int | None = None) -> bool:
+        """Length-sync truncation + recovery restamp (same contract as
+        ReplicaStore.truncate_replica)."""
+        with self._lock:
+            meta = self._meta.get(block_id)
+            if meta is None:
+                return False
+            if meta.logical_len > new_len:
+                if meta.scheme != "direct":
+                    raise IOError(f"block {block_id}: cannot truncate a "
+                                  f"{meta.scheme} replica to {new_len}")
+                self._data[block_id] = self._data[block_id][:new_len]
+                nchunks = -(-new_len // meta.checksum_chunk) if new_len else 0
+                meta.logical_len = meta.physical_len = new_len
+                del meta.checksums[nchunks:]
+                if new_len % meta.checksum_chunk and meta.checksums:
+                    from hdrf_tpu import native
+                    meta.checksums[-1] = native.crc32c(
+                        self._data[block_id][(nchunks - 1)
+                                             * meta.checksum_chunk:])
+            if new_gs is not None and new_gs > meta.gen_stamp:
+                meta.gen_stamp = new_gs
+            return True
+
     def delete(self, block_id: int) -> None:
         with self._lock:
             self._data.pop(block_id, None)

@@ -69,8 +69,11 @@ class TestAppend:
             c1.write("/ap/lease", _bytes(10_000), scheme="direct")
             cluster.namenode.rpc_append("/ap/lease", client=c1.name)
             # second appender is refused while the lease is held
-            with pytest.raises((RpcError, Exception)) as ei:
+            with pytest.raises(RpcError) as ei:
                 c2.append("/ap/lease", b"x")
+            # either refusal is correct: the file is open (OSError) or the
+            # lease is held by c1 (PermissionError) — both name the cause
+            assert ei.value.error in ("OSError", "PermissionError")
             assert "lease" in str(ei.value).lower() or \
                 "open" in str(ei.value).lower()
 
@@ -141,6 +144,81 @@ class TestLengthRecovery:
             assert st["length"] == 2 * 64 * 1024  # the min prefix
             with mc.client("r") as c:
                 assert c.read("/rec/f") == pkt * 2
+
+    def test_recover_lease_before_any_ibr_waits_for_reports(self):
+        """recover_lease racing the DNs' ASYNC IBRs: called while the
+        pipeline sockets are still open (so no IBR has fired yet) it must
+        NOT conclude "no replica survived" and close the file at length 0 —
+        it waits a bounded grace, and once the divergent replicas report it
+        converges to the min CRC-verified prefix."""
+        import socket
+
+        from hdrf_tpu.proto import datatransfer as dt
+
+        with MiniCluster(n_datanodes=2, replication=2,
+                         block_size=1 << 20) as mc:
+            nn = mc.namenode
+            nn.rpc_create("/rec/early", client="w", scheme="direct")
+            alloc = nn.rpc_add_block("/rec/early", client="w")
+            pkt = _bytes(64 * 1024)
+            socks = []
+            for i, dn in enumerate(mc.datanodes):
+                s = socket.create_connection(dn.addr, timeout=10)
+                dt.send_op(s, dt.WRITE_BLOCK, block_id=alloc["block_id"],
+                           gen_stamp=alloc["gen_stamp"], scheme="direct",
+                           token=alloc.get("token"), targets=[])
+                for seq in range(3 if i == 0 else 2):
+                    dt.write_packet(s, seq, pkt)
+                    dt.read_ack(s)
+                socks.append(s)
+            # pipeline still open -> replicas are RBW, no IBR yet: recovery
+            # must decline rather than complete the file empty
+            assert nn.rpc_recover_lease("/rec/early") is False
+            assert nn.rpc_stat("/rec/early")["length"] == 0  # still open
+            assert not nn.rpc_stat("/rec/early")["complete"]
+            for s in socks:
+                s.close()  # now the DNs persist the prefix and IBR
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if nn.rpc_recover_lease("/rec/early"):
+                    break
+                time.sleep(0.3)
+            else:
+                pytest.fail("lease recovery did not close the file")
+            assert nn.rpc_stat("/rec/early")["length"] == 2 * 64 * 1024
+            with mc.client("r") as c:
+                assert c.read("/rec/early") == pkt * 2
+
+    def test_append_crash_preserves_old_generation_replicas(self):
+        """The writer reopens for append (bump_block journals a new gen
+        stamp) then dies before writing a single new-generation byte.  The
+        old-generation replicas are now "stale" — but they are the ONLY
+        copies of the data: the NN must not invalidate them, and lease
+        recovery must restamp them and close the file at its original
+        length (commitBlockSynchronization semantics)."""
+        with MiniCluster(n_datanodes=2, replication=2,
+                         block_size=1 << 20) as mc:
+            data = _bytes(100_000)
+            with mc.client("w") as c:
+                c.write("/rec/ap", data, scheme="direct")
+            nn = mc.namenode
+            nn.rpc_append("/rec/ap", client="w2")
+            nn.rpc_append_block("/rec/ap", client="w2")  # bumps gen stamp
+            # full reports now present the OLD generation: the NN must keep
+            # these replicas (they are the block's only copies)
+            for dn in mc.datanodes:
+                dn._send_block_report()
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if nn.rpc_recover_lease("/rec/ap"):
+                    break
+                time.sleep(0.3)
+            else:
+                pytest.fail("lease recovery did not close the file")
+            st = nn.rpc_stat("/rec/ap")
+            assert st["length"] == len(data)
+            with mc.client("r") as c:
+                assert c.read("/rec/ap") == data
 
     def test_kill_before_any_replica_drops_block(self):
         """No replica ever materialized: recovery closes the file empty

@@ -174,7 +174,15 @@ class TestPlacementAndTrash:
                     time.sleep(0.05)
                 for i in range(6):
                     c.write(f"/r/f{i}", b"z" * 10_000)
-                    loc = c._nn.call("get_block_locations", path=f"/r/f{i}")
+                    # complete() returns once ONE replica reported; wait for
+                    # the second IBR before asserting rack spread
+                    deadline = time.monotonic() + 10
+                    while time.monotonic() < deadline:
+                        loc = c._nn.call("get_block_locations",
+                                         path=f"/r/f{i}")
+                        if len(loc["blocks"][0]["locations"]) >= 2:
+                            break
+                        time.sleep(0.05)
                     racks = {nn._datanodes[ld["dn_id"]].rack
                              for ld in loc["blocks"][0]["locations"]}
                     assert len(racks) == 2, f"replicas on one rack: {racks}"
