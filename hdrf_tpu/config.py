@@ -67,6 +67,12 @@ class ReductionConfig:
     # Execution backend for the per-byte scans: "native" (C++), "tpu" (JAX/Pallas),
     # or "auto" (tpu when an accelerator is present).
     backend: str = "auto"
+    # fsync container data files on append.  Default OFF — HDFS parity:
+    # DataNodes do not fsync block data on finalize (durability comes from
+    # replication; hsync is opt-in per client), and the scanner +
+    # re-replication path covers post-crash chunk loss.  The index WAL is
+    # always fsync'd (metadata integrity is not replication-recoverable).
+    fsync_containers: bool = False
     cdc: CdcConfig = field(default_factory=CdcConfig)
 
 

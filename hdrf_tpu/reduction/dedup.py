@@ -27,6 +27,10 @@ Deliberate fixes over the reference:
 
 from __future__ import annotations
 
+import queue
+import threading
+from concurrent.futures import Future
+
 import numpy as np
 
 from hdrf_tpu.ops import dispatch
@@ -37,8 +41,29 @@ from hdrf_tpu.utils import metrics, tracing
 _M = metrics.registry("dedup")
 
 
+def _block_prep(data, cuts: np.ndarray, digests: np.ndarray):
+    """Shared host prep: (memoryview, ordered hash list, first-occurrence
+    byte ranges).  Vectorized: one tobytes() for all digests and the
+    first-occurrence map via np.unique over a void view (the per-chunk
+    dict-probe loop it replaces measured ~10% of the commit)."""
+    mv = memoryview(data)
+    starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
+    n = len(cuts)
+    blob = np.ascontiguousarray(digests).tobytes()
+    hashes = [blob[i << 5:(i + 1) << 5] for i in range(n)]
+    if n:
+        uniq_idx = np.sort(np.unique(digests.view("V32").reshape(-1),
+                                     return_index=True)[1])
+    else:
+        uniq_idx = []
+    first_range = {hashes[i]: (int(starts[i]), int(cuts[i] - starts[i]))
+                   for i in uniq_idx}
+    return mv, hashes, first_range
+
+
 def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
-                 digests: np.ndarray, index, containers) -> tuple[int, int]:
+                 digests: np.ndarray, index, containers,
+                 on_seal=None) -> tuple[int, int]:
     """The host half of the write pipeline, given device/native reduction
     results: ordered hash list, first-occurrence ranges, index lookup,
     container append of unique bytes, single-record index commit
@@ -46,16 +71,8 @@ def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
     storeDB :372-392).  Shared by DedupScheme.reduce and the full-path
     benchmark so the timed path IS the product path.  Returns
     (chunk_count, new_unique_count)."""
-    mv = data.tobytes() if isinstance(data, np.ndarray) else data
-    starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
+    mv, hashes, first_range = _block_prep(data, cuts, digests)
     n = len(cuts)
-    hashes: list[bytes] = []
-    first_range: dict[bytes, tuple[int, int]] = {}
-    for i in range(n):
-        h = digests[i].tobytes()
-        hashes.append(h)
-        if h not in first_range:
-            first_range[h] = (int(starts[i]), int(cuts[i] - starts[i]))
     if index.get_block(block_id) is not None:
         # Supersede (append rewrote the block under a new gen stamp):
         # release the old entry's chunk refs before committing the new one —
@@ -66,13 +83,102 @@ def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
     new_hashes = [h for h, loc in known.items() if loc is None]
     chunk_bytes = [mv[o:o + ln] for o, ln in
                    (first_range[h] for h in new_hashes)]
-    locs = containers.append_chunks(chunk_bytes, on_seal=index.seal_container)
+    locs = containers.append_chunks(
+        chunk_bytes, on_seal=on_seal or index.seal_container)
     index.commit_block(block_id, len(data), hashes,
                        dict(zip(new_hashes, locs)))
     _M.incr("chunks_total", n)
     _M.incr("chunks_new", len(new_hashes))
     _M.incr("bytes_new", sum(ln for _, _, ln in locs))
     return n, len(new_hashes)
+
+
+class CommitPipeline:
+    """Asynchronous batched commit stage of the dedup write path.
+
+    The reference runs container append + Redis SET in dedicated storer
+    threads off the ingest thread (threadedStorer,
+    DataDeduplicator.java:652-845) with NO durability barrier at all; here
+    one worker thread keeps container layout deterministic while batching
+    the durability cost: chunk bytes for up to ``batch`` queued blocks are
+    appended unsynced, then ONE ``containers.sync_lanes()`` + ONE group
+    WAL commit (``ChunkIndex.commit_blocks``) cover the whole batch, and
+    only then do the blocks' futures resolve.  The index WAL record is
+    always fsync'd; whether the chunk BYTES are fsync'd before it follows
+    the store's ``fsync_containers`` policy (default off = HDFS block-data
+    semantics: page-cache flush only, an OS crash loses the bytes and
+    replication + the scanner recover the block).  A resolved future means
+    "as durable as this deployment's policy makes a finalized replica",
+    not an unconditional disk barrier."""
+
+    def __init__(self, index, containers, batch: int = 4, on_seal=None):
+        self._index = index
+        self._containers = containers
+        self._batch = batch
+        self._on_seal = on_seal or index.seal_container
+        self._q: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(target=self._run,
+                                        name="dedup-commit", daemon=True)
+        self._thread.start()
+
+    def submit(self, block_id: int, data, cuts: np.ndarray,
+               digests: np.ndarray) -> Future:
+        fut: Future = Future()
+        self._q.put((block_id, data, cuts, digests, fut))
+        return fut
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            items = [item]
+            while len(items) < self._batch:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._commit_batch(items)
+                    return
+                items.append(nxt)
+            self._commit_batch(items)
+
+    def _commit_batch(self, items: list) -> None:
+        try:
+            recs = []
+            # chunks first seen earlier IN this batch: visible to later
+            # blocks' dedup even though the index hasn't applied them yet
+            pending_new: dict[bytes, tuple[int, int, int]] = {}
+            for block_id, data, cuts, digests, _ in items:
+                mv, hashes, first_range = _block_prep(data, cuts, digests)
+                if self._index.get_block(block_id) is not None:
+                    self._index.delete_block(block_id)
+                probe = [h for h in first_range if h not in pending_new]
+                known = self._index.lookup_chunks(probe)
+                new_hashes = [h for h in probe if known[h] is None]
+                chunk_bytes = [mv[o:o + ln] for o, ln in
+                               (first_range[h] for h in new_hashes)]
+                locs = self._containers.append_chunks(
+                    chunk_bytes, on_seal=self._on_seal, sync=False)
+                new = dict(zip(new_hashes, locs))
+                pending_new.update(new)
+                recs.append((block_id, len(data), hashes, new))
+                _M.incr("chunks_total", len(hashes))
+                _M.incr("chunks_new", len(new_hashes))
+            self._containers.sync_lanes()  # bytes at least as durable as
+            # the store's policy allows BEFORE the index references them
+            self._index.commit_blocks(recs)
+            for *_, fut in items:
+                fut.set_result(None)
+        except Exception as e:  # noqa: BLE001 — surface at the caller
+            for *_, fut in items:
+                if not fut.done():
+                    fut.set_exception(e)
 
 
 class DedupScheme(ReductionScheme):

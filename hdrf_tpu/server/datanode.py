@@ -75,7 +75,7 @@ class DataNode:
         self.containers = ContainerStore(
             os.path.join(config.data_dir, "containers"),
             container_size=red.container_size, codec=red.container_codec,
-            compress_fn=seal_fn)
+            compress_fn=seal_fn, fsync=red.fsync_containers)
         self.index = ChunkIndex(os.path.join(config.data_dir, "index"))
         self.reduction_ctx = ReductionContext(
             config=red, containers=self.containers, index=self.index,

@@ -133,11 +133,11 @@ class LeaseManager:
     def force_expire(self, path: str) -> None:
         """Mark ``path``'s lease expired NOW (recoverLease): the recovery
         monitor keeps retrying finalization each tick until the file closes,
-        while an expired lease no longer blocks other writers.  Inserts a
-        placeholder when no lease exists so an abandoned file can't get
-        stuck open with nothing driving its recovery."""
-        holder = self._leases.get(path)
-        self._leases[path] = ((holder[0] if holder else "<recovery>"), 0.0)
+        while an expired lease no longer blocks other writers.  The holder
+        becomes the recovery placeholder UNCONDITIONALLY — keeping the
+        original writer's name would let a still-alive writer's renew_all
+        resurrect the lease and silently cancel the forced recovery."""
+        self._leases[path] = ("<recovery>", 0.0)
 
     def drop(self, path: str) -> None:
         self._leases.pop(path, None)
