@@ -31,7 +31,9 @@ bytes.
 Prints ONE JSON line:
   {"metric": ..., "value": <MB/s>, "unit": "MB/s", "vs_baseline": <x>,
    "e2e_value": <MB/s>, "e2e_vs_baseline": <x>,
-   "e2e_ratio_tpu": <r>, "e2e_ratio_cpu": <r>}
+   "e2e_ratio_tpu": <r>, "e2e_ratio_cpu": <r>,
+   "tg_value": <MB/s>, "tg_vs_baseline": <x>,
+   "tg_ratio_tpu": <r>, "tg_ratio_cpu": <r>}   # TeraGen-row corpus
 """
 
 from __future__ import annotations
@@ -52,6 +54,7 @@ N_BLOCKS = 16
 SUB_BATCHES = 4
 CPU_MB = 32
 E2E_BLOCKS = 8          # full-path pass size (HBM also holds container images)
+TG_BLOCKS = 4           # TeraGen-corpus pass size (bounded bench runtime)
 
 
 def _make_block(mb: int, seed: int) -> np.ndarray:
@@ -70,6 +73,34 @@ def _salt(block: np.ndarray, i: int) -> np.ndarray:
     b = block.copy()
     b[:4096] ^= np.uint8((i * 37 + 1) % 251)
     return b
+
+
+def _teragen_blocks(n_blocks: int, mb: int, seed: int = 13) -> list[np.ndarray]:
+    """TeraGen-row corpus (the north-star benchmark's own data,
+    BASELINE.json): 100-byte records — 10 random key bytes, 10 ASCII row-id
+    digits, 78 filler bytes of per-row shifting 10-letter blocks, CRLF.
+    Vectorized; row ids run continuously across blocks."""
+    rng = np.random.default_rng(seed)
+    rows_per_block = (mb << 20) // 100
+    out = []
+    base_id = 0
+    for _ in range(n_blocks):
+        n = rows_per_block
+        rec = np.empty((n, 100), dtype=np.uint8)
+        rec[:, :10] = rng.integers(0, 256, size=(n, 10), dtype=np.uint8)
+        ids = base_id + np.arange(n, dtype=np.int64)
+        for d in range(10):  # ASCII row id, most significant digit first
+            rec[:, 10 + d] = (ids // 10 ** (9 - d) % 10 + 48).astype(np.uint8)
+        blocks_j = (np.arange(78) // 10)[None, :]          # filler block idx
+        rec[:, 20:98] = (65 + (ids[:, None] + blocks_j) % 26).astype(np.uint8)
+        rec[:, 98] = 13
+        rec[:, 99] = 10
+        base_id += n
+        flat = rec.reshape(-1)
+        pad = (mb << 20) - flat.size
+        out.append(np.concatenate([flat,
+                                   np.zeros(pad, np.uint8)]) if pad else flat)
+    return out
 
 
 def _cpu_run(blocks: list[np.ndarray], cdc) -> float:
@@ -246,9 +277,6 @@ def main() -> None:
             value = max(value, N_BLOCKS * (BLOCK_MB << 20) / dt / (1 << 20))
 
         # ------------------------------------------------ full path (e2e)
-        e2e_dev = jax.device_put(np.stack(e2e_hosts))
-        np.asarray(e2e_dev[0, :16])
-        e2e_parts = [e2e_dev[:4], e2e_dev[4:]]
         lz4 = TpuLz4()
 
         SEAL_GROUP = 4  # containers per grouped scan (one readback each)
@@ -259,7 +287,8 @@ def main() -> None:
                 print(f"[{tag}] {label:20s} {time.perf_counter() - t0:7.3f}s",
                       file=sys.stderr)
 
-        def full_pass(tag: str, images: dict | None):
+        def full_pass(tag: str, images: dict | None, hosts: list,
+                      dev_parts: list):
             """One timed full-path pass, software-pipelined across the
             DN's three resources: the DEVICE runs CDC+SHA then the sealed
             containers' LZ4 match scans (grouped: one dispatch + one
@@ -300,10 +329,18 @@ def main() -> None:
 
             from hdrf_tpu.reduction.dedup import CommitPipeline
 
+            # Per-pass adaptive state reset: the flood-streak/bypass
+            # counters are workload-adaptive product state; left to carry
+            # across passes (and corpora) each best-of pass would take a
+            # pass-position-dependent path instead of the same one.
+            with lz4._lock:
+                lz4._flood_streak = 0
+                lz4._bypass_left = 0
+
             index, containers = _fresh_stores(tmp, tag, on_roll=on_roll)
             on_seal = _chain_seal(index, containers)
             t0 = time.perf_counter()
-            bjs = [r.submit_many(h) for h in e2e_parts]
+            bjs = [r.submit_many(h) for h in dev_parts]
             for bj in bjs:
                 r.start_sha_many(bj)
             _dbg(tag, "cdc_sha_dispatch", t0)
@@ -314,7 +351,7 @@ def main() -> None:
             bid = 0
             for bj in bjs:
                 for cuts, digs in r.finish_many(bj):
-                    futs.append(pipe.submit(bid, e2e_hosts[bid], cuts, digs))
+                    futs.append(pipe.submit(bid, hosts[bid], cuts, digs))
                     bid += 1
             _dbg(tag, "digest_readbacks", t0)
             t0 = time.perf_counter()
@@ -348,56 +385,88 @@ def main() -> None:
             index.close()
             return payloads, stored
 
-        # Pre-pass: compile, learn record-slice shapes, and stage container
-        # payload images in HBM (they are identical across passes — fresh
-        # stores + deterministic append order — asserted below).
-        payloads0, _ = full_pass("tpu_warm", None)
+        def run_corpus(hosts: list, label: str, timed: int):
+            """Warm (stage images + compile grouped shapes) then ``timed``
+            best-of passes of the full pipelined path over ``hosts``.
+            Returns (best MB/s, reduction ratio)."""
+            dev = jax.device_put(np.stack(hosts))
+            np.asarray(dev[0, :16])
+            half = len(hosts) // 2
+            dev_parts = [dev[:half], dev[half:]] if half else [dev]
 
-        # Stage every container image at the COMMON 32 MiB grid so groups
-        # batch regardless of exact payload size (pad-region records are
-        # masked by the emit's MFLIMIT cut; zeros sort in the same time).
-        common = max(1 << 25,
-                     max(-(-len(p) // LZ4_TILE) * LZ4_TILE
-                         for _, p in payloads0))
+            # Pre-pass: compile, learn record-slice shapes, and stage
+            # container payload images in HBM (identical across passes —
+            # fresh stores + deterministic append order — asserted below).
+            payloads0, _ = full_pass(f"{label}_warm", None, hosts, dev_parts)
 
-        def _pad_img(b: bytes) -> np.ndarray:
-            a = np.frombuffer(b, np.uint8)
-            return np.concatenate([a, np.zeros(common - a.size, np.uint8)])
+            # Stage every image at the COMMON 32 MiB grid so groups batch
+            # regardless of exact payload size (pad-region records are
+            # masked by the emit's MFLIMIT cut; zeros sort in equal time).
+            common = max(1 << 25,
+                         max(-(-len(p) // LZ4_TILE) * LZ4_TILE
+                             for _, p in payloads0))
 
-        images = {cid: jax.device_put(_pad_img(payload))
-                  for cid, payload in payloads0}
-        sig0 = [(cid, hashlib.sha256(p).digest()) for cid, p in payloads0]
-        full_pass("tpu_warm2", images)  # compile grouped-scan shapes +
-        # learn the record-slice hints for the common image size
-        full_pass("tpu_warm3", images)  # recompile at the LEARNED hints —
-        # without this the first timed pass pays the jit for the widened
-        # record slices (hints only settle during warm2's finish phase)
+            def _pad_img(b: bytes) -> np.ndarray:
+                a = np.frombuffer(b, np.uint8)
+                return np.concatenate([a,
+                                       np.zeros(common - a.size, np.uint8)])
 
-        e2e_value, e2e_stored = 0.0, 1
-        logical = E2E_BLOCKS * (BLOCK_MB << 20)
-        for i in range(3):
-            os.sync()  # same writeback settling as the CPU passes
-            t0 = time.perf_counter()
-            payloads, stored = full_pass(f"tpu{i}", images)
-            dt = time.perf_counter() - t0
-            sig = [(cid, hashlib.sha256(p).digest()) for cid, p in payloads]
-            assert sig == sig0, "timed pass diverged from staged images"
-            if logical / dt / (1 << 20) > e2e_value:
-                e2e_value, e2e_stored = logical / dt / (1 << 20), stored
+            images = {cid: jax.device_put(_pad_img(payload))
+                      for cid, payload in payloads0}
+            sig0 = [(cid, hashlib.sha256(p).digest())
+                    for cid, p in payloads0]
+            # compile grouped-scan shapes, then recompile at the LEARNED
+            # hints — they only settle during the first warm's finish phase
+            full_pass(f"{label}_warm2", images, hosts, dev_parts)
+            full_pass(f"{label}_warm3", images, hosts, dev_parts)
+
+            best, best_stored = 0.0, 1
+            logical = len(hosts) * (BLOCK_MB << 20)
+            for i in range(timed):
+                os.sync()  # same writeback settling as the CPU passes
+                t0 = time.perf_counter()
+                payloads, stored = full_pass(f"{label}{i}", images, hosts,
+                                             dev_parts)
+                dt = time.perf_counter() - t0
+                sig = [(cid, hashlib.sha256(p).digest())
+                       for cid, p in payloads]
+                assert sig == sig0, "timed pass diverged from staged images"
+                if logical / dt / (1 << 20) > best:
+                    best, best_stored = logical / dt / (1 << 20), stored
+            for img in images.values():
+                img.delete()
+            return best, logical / max(best_stored, 1)
+
+        e2e_value, e2e_ratio = run_corpus(e2e_hosts, "tpu", timed=3)
+
+        # TeraGen-row corpus: the north-star benchmark's own data
+        # (BASELINE.json "TeraGen 100 GB, equal ratio").
+        tg_hosts = _teragen_blocks(TG_BLOCKS, BLOCK_MB)
+        tg_cpu, tg_cpu_ratio = 0.0, 1.0
+        for i in range(2):  # best-of-2, like every other baseline here
+            os.sync()
+            v, rr = _cpu_full(tg_hosts, cdc, tmp, f"tg_cpu{i}")
+            if v > tg_cpu:
+                tg_cpu, tg_cpu_ratio = v, rr
+        tg_value, tg_ratio = run_corpus(tg_hosts, "tg", timed=2)
 
         print(json.dumps({
             "metric": "block reduction service rate (CDC+SHA-256), "
                       f"HBM-resident {BLOCK_MB} MiB blocks, overlapped "
                       f"x{N_BLOCKS}; e2e_* = full dedup_lz4 write path "
                       "(+dedup lookup, index WAL commit, container store, "
-                      "TPU LZ4 container seal)",
+                      "TPU LZ4 container seal); tg_* = same on TeraGen rows",
             "value": round(value, 2),
             "unit": "MB/s",
             "vs_baseline": round(value / cpu_value, 3),
             "e2e_value": round(e2e_value, 2),
             "e2e_vs_baseline": round(e2e_value / cpu_e2e, 3),
-            "e2e_ratio_tpu": round(logical / max(e2e_stored, 1), 3),
+            "e2e_ratio_tpu": round(e2e_ratio, 3),
             "e2e_ratio_cpu": round(cpu_ratio, 3),
+            "tg_value": round(tg_value, 2),
+            "tg_vs_baseline": round(tg_value / max(tg_cpu, 0.01), 3),
+            "tg_ratio_tpu": round(tg_ratio, 3),
+            "tg_ratio_cpu": round(tg_cpu_ratio, 3),
         }))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

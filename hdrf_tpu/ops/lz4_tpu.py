@@ -52,11 +52,17 @@ format, decoded by the same ``hdrf_lz4_decompress`` oracle as the CPU path.
 Matching differences vs the byte-serial CPU encoder (ratio, not
 correctness): match starts on ``stride``-aligned positions and offsets of
 the same parity (the emit's backward extension recovers most unaligned
-starts), window <= one supertile, sub-``min_len`` matches skipped.  Measured
-ratios: text/zeros/random within 2%, code ~ +12%, TeraGen rows ~ -35% of the
-serial encoder (the nearest-occurrence rule prefers short RLE references
-where the CPU's sparse table insertion accidentally lands longer structural
-matches).
+starts), window <= one supertile, sub-``min_len`` matches skipped.
+
+Ratio policy (measured): structured data (code, logs) emits at or above the
+serial encoder; degenerate RLE is excluded from the sort and recovered
+exactly by the emit's constant-offset probes (zeros: identical ratio);
+short-match-DENSE data (word-soup text, TeraGen rows at ~9 records per
+100-byte row) exceeds the record-flood cap and falls back to the native
+encoder outright — identical ratio by construction, and an adaptive bypass
+skips the pointless scans once a stream shows its character.  Grey-zone
+containers additionally race the native encoder and keep the smaller
+stream, so the stage's ratio is >= the CPU scheme's on EVERY container.
 """
 
 from __future__ import annotations
@@ -68,6 +74,10 @@ import threading
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from hdrf_tpu.utils import metrics as _metrics
+
+_M_FLOOD = _metrics.registry("lz4_tpu")
 
 _HASH_MUL = np.uint32(2654435761)  # golden-ratio multiplier (lz4.cpp hash4)
 _S = 131072         # supertile span in bytes; window <= LZ4's 65535 anyway
@@ -127,7 +137,15 @@ def _match_scan_impl(block: jax.Array, stride: int, min_len: int,
                           sk[:, :-1]], axis=1)
     pv = jnp.concatenate([jnp.zeros((t, 1), jnp.uint32), sv[:, :-1]], axis=1)
     same = (sk >> jnp.uint32(pos_bits)) == (pk >> jnp.uint32(pos_bits))
-    okm = same & (sv == pv)
+    # Degenerate grams (all four bytes equal — RLE interiors) are excluded:
+    # their nearest occurrence is always the trivial stride-distance
+    # reference, which both floods the record extraction on runs AND
+    # shadows the long STRUCTURAL match (periodic data like TeraGen rows
+    # matches at the row period, but every filler-run gram's nearest
+    # occurrence is delta=stride, so the period is never surfaced).  The
+    # host emit recovers RLE exactly with its constant-offset probes.
+    nondegen = sv != ((sv << jnp.uint32(8)) | (sv >> jnp.uint32(24)))
+    okm = same & (sv == pv) & nondegen
     pmask = jnp.uint32((1 << pos_bits) - 1)
     delta = jnp.where(okm, ((sk & pmask) - (pk & pmask)) * jnp.uint32(stride),
                       jnp.uint32(0))
@@ -260,6 +278,15 @@ class TpuLz4:
         self._p1 = 512
         self._p2 = 4096
         self._p3 = 1 << 17  # L3 packed-record slots (the D2H width)
+        # Workload-adaptive flood bypass: after BYPASS_AFTER consecutive
+        # flood fallbacks, the next BYPASS_RUN submits skip the device scan
+        # entirely (a flooding stream — e.g. a TeraGen ingest — would
+        # otherwise pay a wasted dispatch+readback per container), then one
+        # probing scan re-checks whether the stream changed character.
+        self._flood_streak = 0
+        self._bypass_left = 0
+        self.BYPASS_AFTER = 2
+        self.BYPASS_RUN = 16
         self._lock = threading.Lock()
 
     def _pad(self, a: np.ndarray) -> np.ndarray:
@@ -270,8 +297,9 @@ class TpuLz4:
         entries = n_pad // self.stride
         t3 = entries // _E3
         p1 = min(self._p1, _E3)
-        while p1 * t3 % _L2R:
+        while p1 * t3 % _L2R and p1 < _E3:
             p1 *= 2
+        # _E3 is a multiple of _L2R, so the cap always divides evenly
         p2 = min(self._p2, p1 * t3 // _L2R)
         p3 = min(self._p3, _L2R * p2)
         return p1, p2, p3
@@ -286,6 +314,11 @@ class TpuLz4:
              if not isinstance(data, np.ndarray) else data)
         if a.size < self.min_device:
             return Lz4Job(n=a.size, host=a, block=None, recs=None)
+        with self._lock:
+            if self._bypass_left > 0:
+                self._bypass_left -= 1
+                _M_FLOOD.incr("bypassed_scans")
+                return Lz4Job(n=a.size, host=a, block=None, recs=None)
         if device_image is not None:
             assert device_image.shape[0] % _S == 0
             block = device_image
@@ -310,29 +343,74 @@ class TpuLz4:
         from hdrf_tpu import native
 
         total, g, r = self._unpack(rec_row, job.p3)
-        # Slice overflow dropped records: rescan at the current (possibly
-        # already-widened-by-a-peer-job) shape hints, widening further
-        # (sticky, cheapest slice first) while records still don't fit.
+        # Slice overflow dropped records: jump every hint straight to the
+        # size ``total`` demands (sticky — peers and later jobs reuse it),
+        # then rescan ONCE per hint level; each full rescan costs a
+        # dispatch + readback, so iterative doubling is the wrong shape.
         while total > g.size and job.block is not None:
             with self._lock:
+                def pow2(v: int) -> int:
+                    return 1 << int(max(v, 1) - 1).bit_length()
+
+                need = pow2(total)
+                e_cap = job.block.shape[0] // self.stride
+                if need > max(e_cap // 64, 1 << 16):
+                    # Record flood (> ~8k records/MiB ~= a sequence every
+                    # <128 B): short-match-dense data is the serial
+                    # hash-table encoder's home turf and the sort scan's
+                    # worst case — the native encoder takes over, keeping
+                    # ratio EXACTLY equal to the CPU scheme there.
+                    break
+                t3 = max(e_cap // _E3, 1)
+                self._p3 = max(self._p3, min(need, e_cap))
+                if need > _L2R * self._shapes(job.block.shape[0])[1]:
+                    # per-row L2 slots must cover the records too (skew
+                    # headroom 2x), or the L3 pack starves
+                    self._p2 = max(self._p2,
+                                   min(pow2(2 * need // _L2R),
+                                       e_cap // _L2R))
+                if need > self._shapes(job.block.shape[0])[0] * t3:
+                    # hints stay powers of two: _shapes' divisibility
+                    # doubling must terminate at the _E3 cap
+                    self._p1 = max(self._p1,
+                                   min(_E3, pow2(2 * need // t3)))
                 shapes = self._shapes(job.block.shape[0])
-                if shapes == (job.p1, job.p2, job.p3):
-                    if self._p3 < _L2R * shapes[1]:
-                        self._p3 *= 2
-                    elif self._p2 < job.block.shape[0] // self.stride // _L2R:
-                        self._p2 *= 2
-                    elif self._p1 < _E3:
-                        self._p1 *= 2
-                    else:
-                        break
-                    shapes = self._shapes(job.block.shape[0])
+            if shapes == (job.p1, job.p2, job.p3):
+                break  # capacity exhausted: dropped records cost only ratio
             p1, p2, p3 = shapes
             rec_row = np.asarray(_match_scan(
                 job.block, self.stride, self.min_len, p1, p2, p3))
             job.p1, job.p2, job.p3 = p1, p2, p3
             total, g, r = self._unpack(rec_row, p3)
+        if total > g.size:
+            # Record flood the slices can't represent: short-match-dense
+            # data (e.g. word-soup text needs a sequence every ~9 bytes) is
+            # exactly where a serial hash-table encoder is the right tool —
+            # fall back so ratio matches the CPU scheme instead of
+            # emitting from an arbitrary record subset.
+            _M_FLOOD.incr("native_fallbacks")
+            with self._lock:
+                self._flood_streak += 1
+                if self._flood_streak >= self.BYPASS_AFTER:
+                    self._bypass_left = self.BYPASS_RUN
+            return bytes(native.lz4_compress(job.host))
+        with self._lock:
+            self._flood_streak = 0
         m = g < max(job.n - 12, 0)    # spec MFLIMIT; drops pad-region hits
-        return native.lz4_emit(job.host, g[m], r[m])
+        out = native.lz4_emit(job.host, g[m], r[m])
+        if total > (job.n // self.stride) >> 10:
+            # Grey zone (non-trivial record density below the flood cap):
+            # the sorted matcher can trail the serial encoder by a few
+            # percent here — run the native encoder too and keep the
+            # smaller stream, so the TPU path's ratio is >= the CPU
+            # scheme's BY CONSTRUCTION on every container.  Sparse
+            # containers (incompressible) skip this: both encoders
+            # degenerate to the raw payload anyway.
+            alt = native.lz4_compress(job.host)
+            if len(alt) and len(alt) < len(out):
+                _M_FLOOD.incr("native_wins")
+                out = alt
+        return out
 
     def finish(self, job: Lz4Job) -> bytes:
         from hdrf_tpu import native
@@ -360,6 +438,12 @@ class TpuLz4:
         submits."""
         arrs = [np.frombuffer(d, dtype=np.uint8)
                 if not isinstance(d, np.ndarray) else d for d in datas]
+        with self._lock:
+            if self._bypass_left >= len(arrs):
+                self._bypass_left -= len(arrs)
+                _M_FLOOD.incr("bypassed_scans", len(arrs))
+                return [Lz4Job(n=a.size, host=a, block=None, recs=None)
+                        for a in arrs]
         if device_images is not None:
             shapes = {img.shape[0] for img in device_images}
             if (len(shapes) == 1 and len(arrs) > 1

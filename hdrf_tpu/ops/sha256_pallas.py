@@ -79,18 +79,22 @@ def sha256_words_pallas(words: jax.Array, nblocks: jax.Array) -> jax.Array:
     L, nwords = words.shape
     B = nwords // 16
     R = L // 128
-    T = max(R // _TILE, 1)
+    # Lane-rows pad UP to a whole number of tiles: flooring T here left the
+    # tail rows of non-multiple-of-_TILE lane counts UNPROCESSED — the
+    # output block then carried stale device memory, which even masqueraded
+    # as correct digests whenever a previous dispatch had hashed the same
+    # content into that buffer.
+    R_p = max(-(-R // _TILE) * _TILE, _TILE)
+    T = R_p // _TILE
     wt = jnp.transpose(words.reshape(L, B, 16), (1, 2, 0)).reshape(
         B, 16, R, 128)
     if B % _BC:
         wt = jnp.pad(wt, ((0, _BC - B % _BC), (0, 0), (0, 0), (0, 0)))
-    if R < _TILE:  # tiny buckets: pad lane-rows up to one tile
-        wt = jnp.pad(wt, ((0, 0), (0, 0), (0, _TILE - R), (0, 0)))
-        nb2 = jnp.pad(nblocks.reshape(R, 128), ((0, _TILE - R), (0, 0)))
-        R_p = _TILE
+    if R_p != R:
+        wt = jnp.pad(wt, ((0, 0), (0, 0), (0, R_p - R), (0, 0)))
+        nb2 = jnp.pad(nblocks.reshape(R, 128), ((0, R_p - R), (0, 0)))
     else:
         nb2 = nblocks.reshape(R, 128)
-        R_p = R
     Bp = wt.shape[0]
     out = pl.pallas_call(
         _kernel,

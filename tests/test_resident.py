@@ -113,3 +113,55 @@ def test_batch_lane_count_steps():
     for n in (5475, 43800, 65537, 70000):
         L = _lane_count_geo(n)
         assert L >= n and L % 128 == 0 and (L - n) / n <= 0.126
+
+
+def test_sha_kernel_nonmultiple_tile_lane_rows():
+    """Regression: lane counts whose 128-row count is NOT a multiple of the
+    SHA kernel's row tile (e.g. L=3840 -> 30 rows, tile 8) must still hash
+    every lane.  The grid used to FLOOR the tile count, leaving the tail
+    rows unprocessed — returning stale device memory that could even equal
+    the right digests when a previous dispatch had hashed the same content
+    (how the bug hid from identical-block tests while corrupting mixed
+    batches)."""
+    import hashlib
+
+    import jax
+
+    from hdrf_tpu.ops.sha256 import sha256_words
+
+    for L in (384, 2176, 3840):
+        rng = np.random.default_rng(L)
+        data = rng.integers(0, 256, size=(L, 32), dtype=np.uint8)
+        w = np.zeros((L, 16), dtype=np.uint32)
+        be = data.reshape(L, 8, 4).astype(np.uint32)
+        w[:, :8] = (be[:, :, 0] << 24) | (be[:, :, 1] << 16) \
+            | (be[:, :, 2] << 8) | be[:, :, 3]
+        w[:, 8] = 0x80000000
+        w[:, 15] = 256
+        nb = np.ones(L, np.int32)
+        if jax.default_backend() == "cpu":
+            out = np.asarray(sha256_words(jax.device_put(w),
+                                          jax.device_put(nb)))
+        else:
+            from hdrf_tpu.ops.sha256_pallas import sha256_words_pallas
+
+            out = np.asarray(sha256_words_pallas(jax.device_put(w),
+                                                 jax.device_put(nb)))
+        for i in (0, L // 2, L - 1, L - 128, L - 129 if L > 129 else 0):
+            assert bytes(out[i]) == hashlib.sha256(
+                data[i].tobytes()).digest(), (L, i)
+
+
+def test_mixed_batch_distinct_blocks_match_oracle(reducer):
+    """Regression companion: a batch of DISTINCT blocks (the bench shape
+    that exposed the stale-row bug) must be oracle-identical per block."""
+    rng = np.random.default_rng(77)
+    blocks = []
+    for i in range(4):
+        a = rng.integers(0, 256, size=1 << 20, dtype=np.uint8)
+        a[: 1 << 18] = rng.integers(97, 123, size=1 << 18, dtype=np.uint8)
+        blocks.append(a)
+    for data, (cuts, digs) in zip(blocks, reducer.reduce_many(blocks)):
+        wc, wd = _oracle(data, reducer.cdc)
+        np.testing.assert_array_equal(cuts, wc)
+        np.testing.assert_array_equal(digs, wd)

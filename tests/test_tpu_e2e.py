@@ -51,3 +51,30 @@ def test_datanode_tpu_backend_end_to_end(tmp_path):
     finally:
         dn.stop()
         nn.stop()
+
+
+@pytest.mark.skipif(not _tpu_attached(), reason="needs HDRF_TEST_TPU=1 + TPU")
+def test_pallas_sha_nonmultiple_tile_rows_real_chip():
+    """Real-chip companion of test_resident's stale-row regression: the
+    CPU suite can only exercise the XLA branch, so the Pallas kernel's
+    non-multiple-of-_TILE lane-row handling is asserted here."""
+    import hashlib
+
+    import jax
+
+    from hdrf_tpu.ops.sha256_pallas import sha256_words_pallas
+
+    for L in (384, 3840):
+        rng = np.random.default_rng(L)
+        data = rng.integers(0, 256, size=(L, 32), dtype=np.uint8)
+        w = np.zeros((L, 16), dtype=np.uint32)
+        be = data.reshape(L, 8, 4).astype(np.uint32)
+        w[:, :8] = (be[:, :, 0] << 24) | (be[:, :, 1] << 16) \
+            | (be[:, :, 2] << 8) | be[:, :, 3]
+        w[:, 8] = 0x80000000
+        w[:, 15] = 256
+        out = np.asarray(sha256_words_pallas(
+            jax.device_put(w), jax.device_put(np.ones(L, np.int32))))
+        for i in range(L):
+            assert bytes(out[i]) == hashlib.sha256(
+                data[i].tobytes()).digest(), (L, i)
