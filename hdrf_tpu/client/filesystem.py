@@ -36,12 +36,19 @@ _TR = tracing.tracer("client")
 
 class HdrfClient:
     def __init__(self, namenode_addr,
-                 config: ClientConfig | None = None, name: str | None = None):
+                 config: ClientConfig | None = None, name: str | None = None,
+                 user: str | None = None, groups: list[str] | None = None):
         """``namenode_addr``: one (host, port) or an ordered list of them —
         a list engages the HA failover proxy (retry across NNs on
-        StandbyError / connection failure)."""
+        StandbyError / connection failure).  ``user``/``groups``: the
+        caller identity presented to the NameNode's permission checker
+        (UGI analog); defaults to the OS user."""
+        import getpass
+
         self.config = config or ClientConfig()
         self.name = name or f"client-{uuid.uuid4().hex[:8]}"
+        self.user = user or getpass.getuser()
+        self.groups = list(groups or [])
         from hdrf_tpu.proto.rpc import HaRpcClient, normalize_addrs
 
         addrs = normalize_addrs(namenode_addr)
@@ -53,11 +60,15 @@ class HdrfClient:
                                          renewer=self.name, owner=self.name)
 
     def _call(self, method: str, **kw):
-        """NameNode RPC with the client's delegation token attached (the
-        UGI-token-selector analog: every call authenticates when the
-        cluster requires it)."""
+        """NameNode RPC with the client's delegation token and caller
+        identity attached (the UGI-token-selector analog: every call
+        authenticates — and is permission-checked — when the cluster
+        requires it)."""
         if self._dtoken is not None:
             kw["_dtoken"] = self._dtoken
+        kw["_user"] = self.user
+        if self.groups:
+            kw["_groups"] = self.groups
         return self._nn.call(method, **kw)
 
     def renew_delegation_token(self) -> float:
@@ -154,6 +165,33 @@ class HdrfClient:
 
     def datanode_report(self) -> list[dict]:
         return self._call("datanode_report")
+
+    # -------------------------------------- permissions / ACLs / xattrs
+
+    def chmod(self, path: str, mode: int) -> bool:
+        return self._call("set_permission", path=path, mode=mode)
+
+    def chown(self, path: str, owner: str = "", group: str = "") -> bool:
+        return self._call("set_owner", path=path, owner=owner, group=group)
+
+    def getfacl(self, path: str) -> dict:
+        return self._call("get_acl", path=path)
+
+    def setfacl(self, path: str, spec: str = "", default_spec: str = "",
+                remove_all: bool = False,
+                remove_default: bool = False) -> bool:
+        return self._call("set_acl", path=path, spec=spec,
+                          default_spec=default_spec, remove_all=remove_all,
+                          remove_default=remove_default)
+
+    def setfattr(self, path: str, name: str, value: bytes) -> bool:
+        return self._call("set_xattr", path=path, name=name, value=value)
+
+    def getfattr(self, path: str, names: list[str] | None = None) -> dict:
+        return self._call("get_xattrs", path=path, names=names)
+
+    def removefattr(self, path: str, name: str) -> bool:
+        return self._call("remove_xattr", path=path, name=name)
 
     # ------------------------------------------------- snapshots and quotas
 

@@ -100,6 +100,10 @@ class NameNodeConfig:
     # Block access tokens (dfs.block.access.token.enable analog): NN mints
     # HMAC tokens, DNs verify; keys ride heartbeat responses.
     block_tokens: bool = False
+    # Enforce owner/group/mode + ACLs on namespace RPCs
+    # (dfs.permissions.enabled analog).  The superuser (NN process owner)
+    # and in-process callers always bypass.
+    permissions_enabled: bool = True
     # Require a valid delegation token on client namespace RPCs
     # (hadoop.security.authentication=token analog; DN-protocol and
     # token-acquisition methods stay open — kerberos has no analog here).

@@ -114,6 +114,14 @@ class RpcServer:
         trace = kwargs.pop("_trace", None)
         retry_id = kwargs.pop("_retry_id", None)
         dtoken = kwargs.pop("_dtoken", None)
+        # Caller identity (UGI analog): populated into a per-thread context
+        # the service's permission checker reads.  Only set for WIRE calls —
+        # in-process invocations act as the superuser, like the reference's
+        # own NN threads.
+        from hdrf_tpu.server import permissions as _perm
+
+        _perm.set_caller(kwargs.pop("_user", None),
+                         kwargs.pop("_groups", None))
         fn = getattr(self._service, f"rpc_{method}", None)
         if fn is None:
             return [req_id, 1, {"error": "NoSuchMethod", "message": method}]

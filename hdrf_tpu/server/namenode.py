@@ -31,7 +31,9 @@ from typing import Any
 
 from hdrf_tpu.config import NameNodeConfig
 from hdrf_tpu.proto.rpc import RpcError, RpcServer
+from hdrf_tpu.server import permissions as perm
 from hdrf_tpu.server.editlog import EditLog
+from hdrf_tpu.server.permissions import Attrs, DirNode
 from hdrf_tpu.utils import fault_injection, metrics
 
 _M = metrics.registry("namenode")
@@ -46,6 +48,8 @@ class FileNode:
     complete: bool = False
     mtime: float = 0.0
     ec: str | None = None  # EC policy name ("rs-6-3-64k") or None
+    attrs: Attrs = field(default_factory=lambda: Attrs(
+        "hdrf", "supergroup", 0o644))
 
 
 @dataclass
@@ -161,8 +165,15 @@ class NameNode:
         self.config = config or NameNodeConfig()
         self.role = self.config.role  # "active" | "standby"
         self._lock = threading.RLock()  # the FSNamesystem lock analog
-        # namespace: nested dict tree; leaves are FileNode
-        self._root: dict[str, Any] = {}
+        # The superuser is the NN process owner (dfs.permissions.superusergroup
+        # / UGI of the NN, FSPermissionChecker semantics); in-process callers
+        # (no wire identity) also act as superuser.
+        import getpass
+
+        self._superuser = getpass.getuser()
+        # namespace: nested DirNode tree; leaves are FileNode
+        self._root: DirNode = DirNode(
+            attrs=Attrs(self._superuser, "supergroup", 0o755))
         self._blocks: dict[int, BlockInfo] = {}
         self._groups: dict[int, GroupInfo] = {}  # EC group_id -> group
         self._datanodes: dict[str, DatanodeInfo] = {}
@@ -295,13 +306,16 @@ class NameNode:
                 if isinstance(child, FileNode):
                     out[name] = ["f", child.replication, child.scheme,
                                  child.blocks, child.complete, child.mtime,
-                                 child.ec]
+                                 child.ec, child.attrs.pack()]
                 else:
-                    out[name] = ["d", walk(child)]
+                    out[name] = ["d", walk(child),
+                                 child.attrs.pack()
+                                 if isinstance(child, DirNode) else None]
             return out
 
         return {
             "tree": walk(self._root),
+            "root_attrs": self._root.attrs.pack(),
             "blocks": {b.block_id: [b.gen_stamp, b.length, b.path]
                        for b in self._blocks.values()},
             "groups": {g.group_id: [g.bids, g.logical_len]
@@ -315,17 +329,24 @@ class NameNode:
         }
 
     def _restore(self, snap: dict) -> None:
-        def walk(m: dict) -> dict:
-            out: dict[str, Any] = {}
+        def walk(m: dict) -> DirNode:
+            out = DirNode()
             for name, v in m.items():
                 if v[0] == "f":
-                    out[name] = FileNode(v[1], v[2], list(v[3]), v[4], v[5],
-                                         v[6] if len(v) > 6 else None)
+                    out[name] = FileNode(
+                        v[1], v[2], list(v[3]), v[4], v[5],
+                        v[6] if len(v) > 6 else None,
+                        Attrs.unpack(v[7] if len(v) > 7 else None,
+                                     mode=0o644))
                 else:
-                    out[name] = walk(v[1])
+                    d = walk(v[1])
+                    d.attrs = Attrs.unpack(v[2] if len(v) > 2 else None)
+                    out[name] = d
             return out
 
         self._root = walk(snap["tree"])
+        self._root.attrs = Attrs.unpack(
+            snap.get("root_attrs"), owner=self._superuser)
         self._blocks = {bid: BlockInfo(bid, gs, ln, path)
                         for bid, (gs, ln, path) in snap["blocks"].items()}
         self._groups = {gid: GroupInfo(gid, list(bids), ln)
@@ -343,12 +364,19 @@ class NameNode:
         """Apply one edit record (replay path and live path share this)."""
         op = rec[0]
         if op == "mkdir":
-            self._mkdir_apply(rec[1])
+            self._mkdir_apply(rec[1], user=rec[2] if len(rec) > 2 else None,
+                              mode=rec[3] if len(rec) > 3 else None)
         elif op == "create":
             _, path, replication, scheme, mtime, *rest = rec
-            parent, name = self._parent_of(path, create=True)
+            user = rest[1] if len(rest) > 1 else None
+            mode = rest[2] if len(rest) > 2 else None
+            parent, name = self._parent_of(path, create=True, user=user)
+            attrs = perm.inherit_attrs(
+                self._dir_attrs(parent), user or self._superuser, None,
+                is_dir=False, umode=mode)
             parent[name] = FileNode(replication, scheme, mtime=mtime,
-                                    ec=rest[0] if rest else None)
+                                    ec=rest[0] if rest else None,
+                                    attrs=attrs)
         elif op == "add_block_group":
             _, path, bids, gs = rec
             node = self._file(path)
@@ -442,6 +470,23 @@ class NameNode:
             self._dtokens.apply_renew(rec[1], rec[2])
         elif op == "dt_cancel":
             self._dtokens.apply_cancel(rec[1])
+        elif op == "setperm":
+            self._node_attrs(self._resolve(rec[1])).mode = rec[2]
+        elif op == "setowner":
+            a = self._node_attrs(self._resolve(rec[1]))
+            if rec[2]:
+                a.owner = rec[2]
+            if rec[3]:
+                a.group = rec[3]
+        elif op == "setacl":
+            a = self._node_attrs(self._resolve(rec[1]))
+            a.acl = [list(e) for e in rec[2]]
+            a.dacl = [list(e) for e in rec[3]]
+        elif op == "setxattr":
+            self._node_attrs(self._resolve(rec[1])).xattrs[rec[2]] = \
+                bytes(rec[3])
+        elif op == "rmxattr":
+            self._node_attrs(self._resolve(rec[1])).xattrs.pop(rec[2], None)
         elif op == "set_quota":
             _, path, ns_q, sp_q = rec
             path = "/" + "/".join(self._parts(path))
@@ -675,6 +720,8 @@ class NameNode:
         elif op == "set_quota":
             if not isinstance(self._resolve(rec[1]), dict):
                 raise NotADirectoryError(rec[1])
+        elif op in ("setperm", "setowner", "setacl", "setxattr", "rmxattr"):
+            self._resolve(rec[1])
 
     # ------------------------------------------------------- tree utilities
 
@@ -685,7 +732,8 @@ class NameNode:
             raise ValueError("root path not allowed here")
         return parts
 
-    def _parent_of(self, path: str, create: bool = False) -> tuple[dict, str]:
+    def _parent_of(self, path: str, create: bool = False,
+                   user: str | None = None) -> tuple[dict, str]:
         parts = self._parts(path)
         node = self._root
         for p in parts[:-1]:
@@ -693,11 +741,86 @@ class NameNode:
             if child is None:
                 if not create:
                     raise FileNotFoundError(f"parent of {path} does not exist")
-                child = node[p] = {}
+                child = node[p] = DirNode(attrs=perm.inherit_attrs(
+                    self._dir_attrs(node), user or self._superuser, None,
+                    is_dir=True))
             if isinstance(child, FileNode):
                 raise NotADirectoryError(f"{p} in {path} is a file")
             node = child
         return node, parts[-1]
+
+    @staticmethod
+    def _dir_attrs(node: Any) -> Attrs:
+        return node.attrs if isinstance(node, DirNode) else Attrs(
+            "hdrf", "supergroup", 0o755)
+
+    @staticmethod
+    def _node_attrs(node: Any) -> Attrs:
+        if isinstance(node, (FileNode, DirNode)):
+            return node.attrs
+        raise FileNotFoundError("node has no attributes")
+
+    # ---------------------------------------------------------- permissions
+
+    def _check_access(self, path: str, want: int = 0, parent_want: int = 0,
+                      owner_only: bool = False,
+                      super_only: bool = False) -> None:
+        """FSPermissionChecker.java:49 analog: EXECUTE on every ancestor,
+        ``parent_want`` on the parent directory, ``want`` on the target (if
+        it exists), ``owner_only`` for attribute changes, ``super_only``
+        for admin ops.  The superuser — and in-process callers, which carry
+        no wire identity — bypass, matching the reference."""
+        user, groups = perm.caller()
+        if user is None or user == self._superuser \
+                or not self.config.permissions_enabled:
+            return
+        if super_only:
+            raise PermissionError(f"{user} is not the superuser")
+        raw_parts = [p for p in path.split("/") if p]
+        snapshot_path = ".snapshot" in raw_parts
+        parts = [p for p in raw_parts if p != ".snapshot"]
+        if snapshot_path:
+            # checks walk the LIVE ancestors up to the snapshottable dir;
+            # the frozen target itself is resolved snapshot-aware below
+            parts = raw_parts[:raw_parts.index(".snapshot")]
+        node: Any = self._root
+        chain: list[Any] = [node]
+        for i, p in enumerate(parts):
+            if not isinstance(node, (DirNode, dict)):
+                break
+            attrs = self._dir_attrs(node)
+            if not perm.allows(attrs, user, groups, perm.EXECUTE):
+                raise PermissionError(
+                    f"permission denied: user={user} needs EXECUTE on "
+                    f"/{'/'.join(parts[:i])}")
+            node = node.get(p) if isinstance(node, dict) else None
+            chain.append(node)
+        parent = chain[-2] if len(chain) >= 2 else self._root
+        target = chain[-1] if len(chain) == len(parts) + 1 else None
+        if snapshot_path:
+            # the frozen inode carries the attrs it had at snapshot time;
+            # enforce the target check against those (a 0600 file does not
+            # become readable through /dir/.snapshot/name/...)
+            try:
+                target = self._resolve(path)
+            except (FileNotFoundError, NotADirectoryError):
+                target = None
+            parent = None
+        if parent_want and isinstance(parent, (DirNode, dict)):
+            if not perm.allows(self._dir_attrs(parent), user, groups,
+                               parent_want):
+                raise PermissionError(
+                    f"permission denied: user={user} needs "
+                    f"{'WRITE' if parent_want & 2 else 'READ'} on the "
+                    f"parent of {path}")
+        if target is not None and isinstance(target, (FileNode, DirNode)):
+            attrs = self._node_attrs(target)
+            if owner_only and user != attrs.owner:
+                raise PermissionError(
+                    f"permission denied: {user} is not the owner of {path}")
+            if want and not perm.allows(attrs, user, groups, want):
+                raise PermissionError(
+                    f"permission denied: user={user} on {path}")
 
     def _resolve(self, path: str) -> Any:
         parts = [p for p in path.split("/") if p]
@@ -740,12 +863,17 @@ class NameNode:
             raise IsADirectoryError(path)
         return node
 
-    def _mkdir_apply(self, path: str) -> None:
+    def _mkdir_apply(self, path: str, user: str | None = None,
+                     mode: int | None = None) -> None:
         node = self._root
-        for p in self._parts(path):
+        parts = self._parts(path)
+        for i, p in enumerate(parts):
             child = node.get(p)
             if child is None:
-                child = node[p] = {}
+                child = node[p] = DirNode(attrs=perm.inherit_attrs(
+                    self._dir_attrs(node), user or self._superuser, None,
+                    is_dir=True,
+                    umode=mode if i == len(parts) - 1 else None))
             if isinstance(child, FileNode):
                 raise FileExistsError(f"{path}: {p} is a file")
             node = child
@@ -790,17 +918,22 @@ class NameNode:
         blocks are immutable."""
         if isinstance(node, FileNode):
             return ["f", node.replication, node.scheme, list(node.blocks),
-                    node.complete, node.mtime, node.ec]
+                    node.complete, node.mtime, node.ec, node.attrs.pack()]
         return ["d", {name: NameNode._freeze(child)
-                      for name, child in node.items()}]
+                      for name, child in node.items()},
+                node.attrs.pack() if isinstance(node, DirNode) else None]
 
     def _thaw(self, v: Any) -> Any:
         """Frozen form -> read-only live-form objects (for resolution through
         ``/dir/.snapshot/name/...`` paths)."""
         if v[0] == "f":
             return FileNode(v[1], v[2], list(v[3]), v[4], v[5],
-                            v[6] if len(v) > 6 else None)
-        return {name: self._thaw(child) for name, child in v[1].items()}
+                            v[6] if len(v) > 6 else None,
+                            Attrs.unpack(v[7] if len(v) > 7 else None,
+                                         mode=0o644))
+        d = DirNode({name: self._thaw(child) for name, child in v[1].items()})
+        d.attrs = Attrs.unpack(v[2] if len(v) > 2 else None)
+        return d
 
     def _tree_blocks(self, v: Any) -> tuple[set[int], set[int]]:
         """(block ids, group ids) referenced by a frozen tree."""
@@ -874,16 +1007,20 @@ class NameNode:
 
     # ------------------------------------------------------ client RPC: fs ops
 
-    def rpc_mkdir(self, path: str) -> bool:
+    def rpc_mkdir(self, path: str, mode: int | None = None) -> bool:
         with self._lock:
+            self._check_access(path, parent_want=perm.WRITE)
             self._check_ns_quota(path)
-            self._log(["mkdir", path])
+            self._log(["mkdir", path,
+                       perm.caller()[0] or self._superuser, mode])
             _M.incr("mkdir")
             return True
 
     def rpc_create(self, path: str, client: str, replication: int | None = None,
-                   scheme: str | None = None, ec: str | None = None) -> dict:
+                   scheme: str | None = None, ec: str | None = None,
+                   mode: int | None = None) -> dict:
         with self._lock:
+            self._check_access(path, parent_want=perm.WRITE)
             replication = replication or self.config.replication
             scheme = scheme or "direct"
             if ec is not None:
@@ -908,7 +1045,8 @@ class NameNode:
                 # its allocated blocks are invalidated on DNs rather than
                 # leaking in the block map forever.
                 self._log(["delete", path])
-            self._log(["create", path, replication, scheme, time.time(), ec])
+            self._log(["create", path, replication, scheme, time.time(), ec,
+                       perm.caller()[0] or self._superuser, mode])
             self._leases.acquire(path, client)
             _M.incr("create")
             return {"block_size": self.config.block_size, "scheme": scheme,
@@ -975,6 +1113,7 @@ class NameNode:
         deduplicated block has no meaning; CDC makes the re-reduction of
         the rewritten block dedup against its own old chunks)."""
         with self._lock:
+            self._check_access(path, want=perm.WRITE)
             node = self._file(path)
             if not node.complete:
                 raise IOError(f"{path} is already open for writing")
@@ -1026,6 +1165,7 @@ class NameNode:
         balancer), the same deferred-trim the reference's truncate recovery
         performs."""
         with self._lock:
+            self._check_access(path, want=perm.WRITE)
             node = self._file(path)
             if not node.complete:
                 raise IOError(f"{path} is open for writing")
@@ -1093,6 +1233,7 @@ class NameNode:
 
     def rpc_get_block_locations(self, path: str) -> dict:
         with self._lock:
+            self._check_access(path, want=perm.READ)
             node = self._file(path)
             _M.incr("get_block_locations")
             if node.ec:
@@ -1131,6 +1272,7 @@ class NameNode:
 
     def rpc_delete(self, path: str) -> bool:
         with self._lock:
+            self._check_access(path, parent_want=perm.WRITE)
             try:
                 self._resolve(path)
             except FileNotFoundError:
@@ -1141,6 +1283,8 @@ class NameNode:
 
     def rpc_rename(self, src: str, dst: str) -> bool:
         with self._lock:
+            self._check_access(src, parent_want=perm.WRITE)
+            self._check_access(dst, parent_want=perm.WRITE)
             self._resolve(src)
             s = "/" + "/".join(self._parts(src))
             d = "/" + "/".join(p for p in dst.split("/") if p)
@@ -1151,6 +1295,7 @@ class NameNode:
 
     def rpc_listing(self, path: str) -> list[dict]:
         with self._lock:
+            self._check_access(path, want=perm.READ)
             node = self._resolve(path)
             if isinstance(node, FileNode):
                 return [self._stat_entry(path.rstrip("/").rsplit("/", 1)[-1], node)]
@@ -1159,6 +1304,7 @@ class NameNode:
 
     def rpc_stat(self, path: str) -> dict:
         with self._lock:
+            self._check_access(path)  # traverse (getFileInfo semantics)
             node = self._resolve(path)
             name = path.rstrip("/").rsplit("/", 1)[-1] or "/"
             return self._stat_entry(name, node)
@@ -1171,32 +1317,180 @@ class NameNode:
             else:
                 length = sum(max(self._blocks[b].length, 0)
                              for b in node.blocks if b in self._blocks)
+            a = node.attrs
             return {"name": name, "type": "file", "length": length,
                     "replication": node.replication, "scheme": node.scheme,
                     "complete": node.complete, "blocks": len(node.blocks),
-                    "mtime": node.mtime, "ec": node.ec}
-        return {"name": name, "type": "dir", "children": len(node)}
+                    "mtime": node.mtime, "ec": node.ec,
+                    "owner": a.owner, "group": a.group, "mode": a.mode}
+        a = self._dir_attrs(node)
+        return {"name": name, "type": "dir", "children": len(node),
+                "owner": a.owner, "group": a.group, "mode": a.mode}
+
+    # ------------------------------------------- permissions / ACLs / xattrs
+
+    def rpc_set_permission(self, path: str, mode: int) -> bool:
+        """chmod (FSDirAttrOp.setPermission): owner or superuser only."""
+        with self._lock:
+            self._check_access(path, owner_only=True)
+            self._resolve(path)
+            self._log(["setperm", path, int(mode) & 0o7777])
+            _M.incr("setperm")
+            return True
+
+    def rpc_set_owner(self, path: str, owner: str = "",
+                      group: str = "") -> bool:
+        """chown/chgrp.  Changing the OWNER is superuser-only (HDFS
+        semantics); the owner may change the group — but only to a group
+        they belong to (FSDirAttrOp rejects foreign-group attribution)."""
+        with self._lock:
+            if owner:
+                self._check_access(path, super_only=True)
+            else:
+                self._check_access(path, owner_only=True)
+                user, groups = perm.caller()
+                if group and user is not None \
+                        and user != self._superuser \
+                        and self.config.permissions_enabled \
+                        and group not in groups:
+                    raise PermissionError(
+                        f"{user} is not a member of group {group}")
+            self._resolve(path)
+            self._log(["setowner", path, owner, group])
+            _M.incr("setowner")
+            return True
+
+    def rpc_get_acl(self, path: str) -> dict:
+        """getfacl (FSDirAclOp.getAclStatus analog)."""
+        with self._lock:
+            self._check_access(path, want=perm.READ)
+            a = self._node_attrs(self._resolve(path))
+            return {"owner": a.owner, "group": a.group, "mode": a.mode,
+                    "entries": perm.acl_to_strings(a),
+                    "acl": [list(e) for e in a.acl],
+                    "default_acl": [list(e) for e in a.dacl]}
+
+    def rpc_set_acl(self, path: str, spec: str = "",
+                    default_spec: str = "", remove_all: bool = False,
+                    remove_default: bool = False) -> bool:
+        """setfacl: ``spec``/``default_spec`` use the setfacl entry syntax
+        ('user:alice:rwx,group::r-x'); modify semantics (entries merge by
+        (kind, name)); ``remove_all``/``remove_default`` mirror -b / -k.
+        Persisted through the editlog like every namespace mutation
+        (AclStorage.java:65 stores ACL features on the inode the same way)."""
+        with self._lock:
+            self._check_access(path, owner_only=True)
+            a = self._node_attrs(self._resolve(path))
+            if remove_all:
+                acl, dacl = [], []
+            elif remove_default:
+                acl, dacl = [list(e) for e in a.acl], []
+            else:
+                def merge(cur: list, new: list) -> list:
+                    out = {(k, n): [k, n, p] for k, n, p in cur}
+                    for k, n, p in new:
+                        out[(k, n)] = [k, n, p]
+                    return list(out.values())
+
+                def remask(entries: list, group_bits: int,
+                           explicit_mask: bool) -> list:
+                    """POSIX setfacl: unless THIS spec set a mask
+                    explicitly, the mask recalculates to the union of the
+                    group class (named users/groups + owning-group bits) —
+                    a stale mask must not silently limit a fresh grant."""
+                    if not entries or explicit_mask:
+                        return entries
+                    entries = [e for e in entries if e[0] != "mask"]
+                    u = group_bits
+                    for k, n, p in entries:
+                        if k in ("user", "group") and n:
+                            u |= p
+                    return entries + [["mask", "", u]]
+
+                gbits = (a.mode >> 3) & 7
+                new_a = perm.acl_spec_parse(spec) if spec else []
+                acl = remask(merge(a.acl, new_a), gbits,
+                             any(e[0] == "mask" for e in new_a))
+                new_d = perm.acl_spec_parse(default_spec) \
+                    if default_spec else []
+                if new_d and not isinstance(self._resolve(path), DirNode):
+                    raise ValueError("default ACLs apply to directories only")
+                dacl = remask(merge(a.dacl, new_d), gbits,
+                              any(e[0] == "mask" for e in new_d))
+            self._log(["setacl", path, acl, dacl])
+            _M.incr("setacl")
+            return True
+
+    def rpc_set_xattr(self, path: str, name: str, value: bytes) -> bool:
+        """setfattr (FSDirXAttrOp.java:46 analog).  Namespaces: ``user.``
+        needs WRITE on the inode; ``trusted.`` is superuser-only."""
+        with self._lock:
+            self._check_xattr_ns(path, name, writing=True)
+            self._resolve(path)
+            self._log(["setxattr", path, name, bytes(value)])
+            _M.incr("setxattr")
+            return True
+
+    def rpc_get_xattrs(self, path: str,
+                       names: list[str] | None = None) -> dict:
+        with self._lock:
+            self._check_access(path, want=perm.READ)
+            a = self._node_attrs(self._resolve(path))
+            user, _ = perm.caller()
+            out = {}
+            for k, v in a.xattrs.items():
+                if names is not None and k not in names:
+                    continue
+                if k.startswith("trusted.") and user is not None \
+                        and user != self._superuser \
+                        and self.config.permissions_enabled:
+                    continue  # trusted.* hidden from non-superusers
+                out[k] = bytes(v)
+            return out
+
+    def rpc_remove_xattr(self, path: str, name: str) -> bool:
+        with self._lock:
+            self._check_xattr_ns(path, name, writing=True)
+            self._resolve(path)
+            self._log(["rmxattr", path, name])
+            return True
+
+    def _check_xattr_ns(self, path: str, name: str, writing: bool) -> None:
+        ns = name.split(".", 1)[0] if "." in name else ""
+        if ns not in ("user", "trusted", "system", "raw"):
+            raise ValueError(f"xattr {name!r} lacks a valid namespace "
+                             "(user./trusted./system./raw.)")
+        if ns in ("trusted", "system", "raw"):
+            self._check_access(path, super_only=True)
+        else:
+            self._check_access(path, want=perm.WRITE)
 
     # ----------------------------------------------------- snapshots & quotas
 
     def rpc_allow_snapshot(self, path: str) -> bool:
+        """Superuser-only, like dfsadmin -allowSnapshot."""
         with self._lock:
+            self._check_access(path, super_only=True)
             self._log(["allow_snapshot", path])
             return True
 
     def rpc_create_snapshot(self, path: str, name: str) -> bool:
+        """Requires ownership of the snapshottable dir (HDFS semantics)."""
         with self._lock:
+            self._check_access(path, owner_only=True)
             self._log(["create_snapshot", path, name])
             _M.incr("snapshots_created")
             return True
 
     def rpc_delete_snapshot(self, path: str, name: str) -> bool:
         with self._lock:
+            self._check_access(path, owner_only=True)
             self._log(["delete_snapshot", path, name])
             return True
 
     def rpc_list_snapshots(self, path: str) -> list[str]:
         with self._lock:
+            self._check_access(path, want=perm.READ)
             p = "/" + "/".join(self._parts(path))
             if p not in self._snapshots:
                 raise FileNotFoundError(f"{p} is not snapshottable")
@@ -1204,14 +1498,16 @@ class NameNode:
 
     def rpc_set_quota(self, path: str, namespace_quota: int = -1,
                       space_quota: int = -1) -> bool:
-        """-1/-1 clears (setQuota/clrQuota analog)."""
+        """-1/-1 clears (setQuota/clrQuota analog).  Superuser-only."""
         with self._lock:
+            self._check_access(path, super_only=True)
             self._log(["set_quota", path, namespace_quota, space_quota])
             return True
 
     def rpc_content_summary(self, path: str) -> dict:
         """du -s analog (getContentSummary)."""
         with self._lock:
+            self._check_access(path, want=perm.READ)
             node = self._resolve(path)
             files = dirs = length = 0
             if isinstance(node, FileNode):
@@ -1496,6 +1792,7 @@ class NameNode:
 
     def rpc_save_namespace(self) -> bool:
         with self._lock:
+            self._check_access("/", super_only=True)
             if self.role != "active":
                 raise StandbyError("namenode is standby")
             self._editlog.checkpoint()
@@ -1643,6 +1940,8 @@ class NameNode:
     def rpc_safemode(self, action: str = "get") -> bool:
         """dfsadmin -safemode get|enter|leave|forceExit analog."""
         with self._lock:
+            if action != "get":
+                self._check_access("/", super_only=True)
             if action == "enter":
                 self._safemode_forced = True
             elif action in ("leave", "forceExit"):
@@ -1658,6 +1957,7 @@ class NameNode:
         placements, and its blocks are re-replicated elsewhere; poll
         rpc_decommission_status for completion, then stop the DN."""
         with self._lock:
+            self._check_access("/", super_only=True)
             if dn_id not in self._datanodes:
                 return False
             self._decommissioning.add(dn_id)
@@ -1669,6 +1969,7 @@ class NameNode:
         """Return a drained (or repaired) DN to service — clears the exclude
         state so placement uses it again (refreshNodes-after-edit analog)."""
         with self._lock:
+            self._check_access("/", super_only=True)
             if dn_id not in self._decommissioning:
                 return False
             self._decommissioning.discard(dn_id)
@@ -1749,7 +2050,9 @@ class NameNode:
 
     _EVENT_TYPES = {"create": "create", "complete": "close",
                     "delete": "unlink", "rename": "rename",
-                    "mkdir": "mkdir"}
+                    "mkdir": "mkdir", "setperm": "metadata",
+                    "setowner": "metadata", "setacl": "metadata",
+                    "setxattr": "metadata", "rmxattr": "metadata"}
 
     def _emit_event(self, rec: list) -> None:
         kind = self._EVENT_TYPES.get(rec[0])

@@ -9,6 +9,7 @@ dispatch; DFSAdmin, OfflineImageViewer / OfflineEditsViewer under
   httpfs                   WebHDFS-style HTTP gateway
   dfs                      -ls -mkdir -put -get -cat -rm -mv -stat -du -count
                            -createSnapshot -deleteSnapshot -lsSnapshots
+                           -chmod -chown -getfacl -setfacl -setfattr -getfattr
   dfsadmin                 -report -savenamespace -metrics -movblock
                            -allowSnapshot -setQuota -setSpaceQuota -clrQuota
                            -safemode -decommission -decommissionStatus
@@ -145,6 +146,44 @@ def cmd_dfs(args) -> int:
         elif args.op == "-lsSnapshots":
             for name in c.list_snapshots(args.args[0]):
                 print(name)
+        elif args.op == "-chmod":
+            c.chmod(args.args[1], int(args.args[0], 8))
+        elif args.op == "-chown":
+            spec, path = args.args
+            owner, _, group = spec.partition(":")
+            c.chown(path, owner=owner, group=group)
+        elif args.op == "-getfacl":
+            for line in c.getfacl(args.args[0])["entries"]:
+                print(line)
+        elif args.op == "-setfacl":
+            # -setfacl [-b | -k] <path> | -setfacl -m <spec> <path>
+            if args.args[0] == "-b":
+                c.setfacl(args.args[1], remove_all=True)
+            elif args.args[0] == "-k":
+                c.setfacl(args.args[1], remove_default=True)
+            else:
+                spec = args.args[1] if args.args[0] == "-m" else args.args[0]
+                path = args.args[-1]
+                acc = ",".join(e for e in spec.split(",")
+                               if not e.startswith("default:"))
+                dfl = ",".join(e[len("default:"):]
+                               for e in spec.split(",")
+                               if e.startswith("default:"))
+                c.setfacl(path, spec=acc, default_spec=dfl)
+        elif args.op == "-setfattr":
+            # -setfattr -n name [-v value] <path> | -setfattr -x name <path>
+            if args.args[0] == "-x":
+                c.removefattr(args.args[2], args.args[1])
+            else:
+                name = args.args[1]
+                if "-v" in args.args:
+                    v = args.args[args.args.index("-v") + 1].encode()
+                else:
+                    v = b""
+                c.setfattr(args.args[-1], name, v)
+        elif args.op == "-getfattr":
+            for k, v in sorted(c.getfattr(args.args[0]).items()):
+                print(f"{k}={v.decode(errors='replace')}")
         else:
             print(f"unknown dfs op {args.op}", file=sys.stderr)
             return 1

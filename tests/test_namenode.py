@@ -32,8 +32,11 @@ def complete(nn, path, lengths, client="c1"):
 class TestNamespace:
     def test_mkdir_listing_stat(self, nn):
         nn.rpc_mkdir("/a/b/c")
-        assert nn.rpc_listing("/a") == [{"name": "b", "type": "dir", "children": 1}]
-        assert nn.rpc_stat("/a/b/c") == {"name": "c", "type": "dir", "children": 0}
+        (ent,) = nn.rpc_listing("/a")
+        assert (ent["name"], ent["type"], ent["children"]) == ("b", "dir", 1)
+        st = nn.rpc_stat("/a/b/c")
+        assert (st["name"], st["type"], st["children"]) == ("c", "dir", 0)
+        assert st["mode"] == 0o755 and st["owner"]  # inode attributes exist
 
     def test_create_write_flow(self, nn):
         register(nn)

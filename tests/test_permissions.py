@@ -1,0 +1,298 @@
+"""Permissions, ACLs, and xattrs (FSPermissionChecker.java:49,
+AclStorage.java:65, FSDirXAttrOp.java:46 analogs).
+
+The caller identity rides the RPC (`_user`/`_groups` -> per-thread context);
+in-process calls act as the superuser, so these tests talk over the WIRE via
+RpcClient/HdrfClient with explicit users."""
+
+from __future__ import annotations
+
+import getpass
+
+import pytest
+
+from hdrf_tpu.client.filesystem import HdrfClient
+from hdrf_tpu.config import NameNodeConfig
+from hdrf_tpu.proto.rpc import RpcError
+from hdrf_tpu.server.namenode import NameNode
+
+SUPER = getpass.getuser()
+
+
+@pytest.fixture()
+def nn(tmp_path):
+    n = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "nn"),
+                                replication=1, block_size=1 << 20)).start()
+    yield n
+    n.stop()
+
+
+def client(nn, user, groups=()):
+    return HdrfClient(nn.addr, user=user, groups=list(groups))
+
+
+class TestModeBits:
+    def test_owner_and_inheritance(self, nn):
+        with client(nn, SUPER) as su, client(nn, "alice") as al:
+            su.mkdir("/home")
+            su.chmod("/home", 0o777)
+            al.mkdir("/home/alice")
+            st = al.stat("/home/alice")
+            assert st["owner"] == "alice" and st["mode"] == 0o755
+
+    def test_write_denied_without_parent_write(self, nn):
+        with client(nn, SUPER) as su, client(nn, "bob") as bob:
+            su.mkdir("/locked")          # superuser-owned, 0755
+            with pytest.raises(RpcError) as ei:
+                bob.mkdir("/locked/sub")
+            assert ei.value.error == "PermissionError"
+            with pytest.raises(RpcError):
+                bob._call("create", path="/locked/f", client=bob.name)
+
+    def test_read_denied_by_mode(self, nn):
+        with client(nn, SUPER) as su, client(nn, "eve") as eve:
+            su.mkdir("/priv")
+            su.chmod("/priv", 0o700)
+            with pytest.raises(RpcError):
+                eve.ls("/priv")
+            # traverse through a 0700 dir also fails (EXECUTE on ancestor)
+            with pytest.raises(RpcError):
+                eve._call("get_block_locations", path="/priv/x")
+
+    def test_chmod_owner_only(self, nn):
+        with client(nn, SUPER) as su, client(nn, "alice") as al, \
+                client(nn, "bob") as bob:
+            su.mkdir("/home")
+            su.chmod("/home", 0o777)
+            al.mkdir("/home/alice")
+            with pytest.raises(RpcError):
+                bob.chmod("/home/alice", 0o777)
+            assert al.chmod("/home/alice", 0o700)
+            assert al.stat("/home/alice")["mode"] == 0o700
+
+    def test_chown_superuser_only(self, nn):
+        with client(nn, SUPER) as su, client(nn, "alice") as al:
+            su.mkdir("/d")
+            with pytest.raises(RpcError):
+                al.chown("/d", owner="alice")
+            assert su.chown("/d", owner="alice", group="staff")
+            st = su.stat("/d")
+            assert st["owner"] == "alice" and st["group"] == "staff"
+
+    def test_group_access(self, nn):
+        with client(nn, SUPER) as su, \
+                client(nn, "carol", groups=["eng"]) as carol:
+            su.mkdir("/shared")
+            su.chown("/shared", group="eng")
+            su.chmod("/shared", 0o770)
+            carol.mkdir("/shared/x")  # group WRITE via membership
+            assert carol.ls("/shared")
+
+
+class TestAcls:
+    def test_named_user_acl_grants_access(self, nn):
+        with client(nn, SUPER) as su, client(nn, "dave") as dave:
+            su.mkdir("/acl")
+            su.chmod("/acl", 0o700)
+            with pytest.raises(RpcError):
+                dave.ls("/acl")
+            su.setfacl("/acl", spec="user:dave:r-x")
+            assert dave.ls("/acl") == []
+            # but no WRITE
+            with pytest.raises(RpcError):
+                dave.mkdir("/acl/w")
+
+    def test_mask_limits_named_entries(self, nn):
+        with client(nn, SUPER) as su, client(nn, "dave") as dave:
+            su.mkdir("/m")
+            su.chmod("/m", 0o700)
+            su.setfacl("/m", spec="user:dave:rwx,mask::r-x")
+            assert dave.ls("/m") == []          # r through mask
+            with pytest.raises(RpcError):
+                dave.mkdir("/m/w")              # w masked out
+
+    def test_default_acl_inherited(self, nn):
+        with client(nn, SUPER) as su, client(nn, "erin") as erin:
+            su.mkdir("/proj")
+            su.chmod("/proj", 0o777)
+            su.setfacl("/proj", default_spec="user:erin:rwx")
+            su.mkdir("/proj/sub")
+            su.chmod("/proj/sub", 0o700)
+            # child inherited the default ACL as its access ACL
+            assert erin.ls("/proj/sub") == []
+            acl = su.getfacl("/proj/sub")
+            assert ["user", "erin", 7] in acl["acl"]
+
+    def test_getfacl_strings(self, nn):
+        with client(nn, SUPER) as su:
+            su.mkdir("/fmt")
+            su.setfacl("/fmt", spec="user:zed:rw-")
+            ent = su.getfacl("/fmt")["entries"]
+            assert "user:zed:rw-" in ent and any(
+                e.startswith("user::") for e in ent)
+
+    def test_remove_all(self, nn):
+        with client(nn, SUPER) as su, client(nn, "dave") as dave:
+            su.mkdir("/rb")
+            su.chmod("/rb", 0o700)
+            su.setfacl("/rb", spec="user:dave:r-x")
+            assert dave.ls("/rb") == []
+            su.setfacl("/rb", remove_all=True)
+            with pytest.raises(RpcError):
+                dave.ls("/rb")
+
+
+class TestXattrs:
+    def test_user_xattr_roundtrip(self, nn):
+        with client(nn, SUPER) as su:
+            su.mkdir("/x")
+            su.setfattr("/x", "user.tag", b"gold")
+            assert su.getfattr("/x") == {"user.tag": b"gold"}
+            su.removefattr("/x", "user.tag")
+            assert su.getfattr("/x") == {}
+
+    def test_trusted_ns_superuser_only(self, nn):
+        with client(nn, SUPER) as su, client(nn, "alice") as al:
+            su.mkdir("/x")
+            su.chmod("/x", 0o777)
+            su.setfattr("/x", "trusted.t", b"1")
+            with pytest.raises(RpcError):
+                al.setfattr("/x", "trusted.evil", b"1")
+            # trusted.* hidden from non-superusers
+            assert "trusted.t" not in al.getfattr("/x")
+            assert su.getfattr("/x")["trusted.t"] == b"1"
+
+    def test_namespace_required(self, nn):
+        with client(nn, SUPER) as su:
+            su.mkdir("/x")
+            with pytest.raises(RpcError):
+                su.setfattr("/x", "nonamespace", b"v")
+
+
+class TestPersistence:
+    def test_attrs_survive_restart(self, nn, tmp_path):
+        with client(nn, SUPER) as su:
+            su.mkdir("/keep")
+            su.chmod("/keep", 0o750)
+            su.chown("/keep", owner="alice", group="eng")
+            su.setfacl("/keep", spec="user:bob:r--")
+            su.setfattr("/keep", "user.k", b"v")
+        nn.stop()
+        nn2 = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "nn"),
+                                      replication=1)).start()
+        try:
+            with client(nn2, SUPER) as su:
+                st = su.stat("/keep")
+                assert (st["owner"], st["group"], st["mode"]) == \
+                    ("alice", "eng", 0o750)
+                assert ["user", "bob", 4] in su.getfacl("/keep")["acl"]
+                assert su.getfattr("/keep")["user.k"] == b"v"
+        finally:
+            nn2.stop()
+
+    def test_ha_failover_preserves_acls(self, tmp_path):
+        """ACLs/xattrs set on the active survive a failover to the standby
+        (they ride the shared edit log like every mutation)."""
+        from hdrf_tpu.testing.minicluster import MiniCluster
+
+        with MiniCluster(n_datanodes=1, replication=1, ha=True) as mc:
+            with HdrfClient(mc.nn_addrs(), user=SUPER) as c:
+                c.mkdir("/ha")
+                c.chmod("/ha", 0o750)
+                c.chown("/ha", owner="alice", group="eng")
+                c.setfacl("/ha", spec="user:bob:rwx",
+                          default_spec="user:bob:r-x")
+                c.setfattr("/ha", "user.site", b"a1")
+            mc.failover()
+            with HdrfClient([mc.namenode.addr], user=SUPER) as c:
+                st = c.stat("/ha")
+                assert (st["owner"], st["group"], st["mode"]) == \
+                    ("alice", "eng", 0o750)
+                acl = c.getfacl("/ha")
+                assert ["user", "bob", 7] in acl["acl"]
+                assert ["user", "bob", 5] in acl["default_acl"]
+                assert c.getfattr("/ha")["user.site"] == b"a1"
+                # enforcement still live post-failover
+                with HdrfClient([mc.namenode.addr], user="mallory") as m:
+                    with pytest.raises(RpcError):
+                        m.chmod("/ha", 0o777)
+
+
+class TestCli:
+    def test_chmod_acl_xattr_via_shell(self, nn, capsys):
+        from hdrf_tpu.tools import cli
+
+        addr = f"{nn.addr[0]}:{nn.addr[1]}"
+        assert cli.main(["dfs", "--namenode", addr, "-mkdir", "/c"]) == 0
+        assert cli.main(["dfs", "--namenode", addr, "-chmod", "750", "/c"]) == 0
+        assert cli.main(["dfs", "--namenode", addr, "-chown", "alice:eng",
+                         "/c"]) == 0
+        assert cli.main(["dfs", "--namenode", addr, "-setfacl", "-m",
+                         "user:bob:rwx,default:user:bob:r-x", "/c"]) == 0
+        assert cli.main(["dfs", "--namenode", addr, "-getfacl", "/c"]) == 0
+        out = capsys.readouterr().out
+        assert "user:bob:rwx" in out and "default:user:bob:r-x" in out
+        assert cli.main(["dfs", "--namenode", addr, "-setfattr", "-n", "user.k",
+                         "-v", "v1", "/c"]) == 0
+        assert cli.main(["dfs", "--namenode", addr, "-getfattr", "/c"]) == 0
+        assert "user.k=v1" in capsys.readouterr().out
+        st = nn.rpc_stat("/c")
+        assert (st["owner"], st["group"], st["mode"]) == \
+            ("alice", "eng", 0o750)
+
+
+class TestReviewHoles:
+    def test_snapshot_path_does_not_bypass_mode(self, nn):
+        """A 0600 file must not become readable through
+        /dir/.snapshot/name/... (the frozen inode keeps its attrs)."""
+        with client(nn, SUPER) as su, client(nn, "mallory") as m:
+            su.mkdir("/d")
+            su.chmod("/d", 0o755)
+            su._call("create", path="/d/secret", client="w")
+            su._call("complete", path="/d/secret", client="w",
+                     block_lengths={})
+            su.chmod("/d/secret", 0o600)
+            su._call("allow_snapshot", path="/d")
+            su._call("create_snapshot", path="/d", name="s1")
+            with pytest.raises(RpcError) as ei:
+                m._call("get_block_locations", path="/d/.snapshot/s1/secret")
+            assert ei.value.error == "PermissionError"
+
+    def test_snapshot_and_quota_ops_checked(self, nn):
+        with client(nn, SUPER) as su, client(nn, "mallory") as m:
+            su.mkdir("/q")
+            su._call("allow_snapshot", path="/q")
+            su.create_snapshot("/q", "s1")
+            with pytest.raises(RpcError):
+                m._call("allow_snapshot", path="/q")
+            with pytest.raises(RpcError):
+                m.delete_snapshot("/q", "s1")
+            with pytest.raises(RpcError):
+                m.set_quota("/q", namespace_quota=1)
+
+    def test_stat_requires_traverse(self, nn):
+        with client(nn, SUPER) as su, client(nn, "mallory") as m:
+            su.mkdir("/p2")
+            su.chmod("/p2", 0o700)
+            with pytest.raises(RpcError):
+                m.stat("/p2/x")
+            with pytest.raises(RpcError):
+                m.content_summary("/p2")
+
+    def test_chgrp_requires_membership(self, nn):
+        with client(nn, SUPER) as su, \
+                client(nn, "alice", groups=["eng"]) as al:
+            su.mkdir("/home")
+            su.chmod("/home", 0o777)
+            al.mkdir("/home/alice")
+            with pytest.raises(RpcError):
+                al.chown("/home/alice", group="finance")
+            assert al.chown("/home/alice", group="eng")
+
+    def test_modify_recalculates_stale_mask(self, nn):
+        with client(nn, SUPER) as su, client(nn, "carol") as carol:
+            su.mkdir("/msk")
+            su.chmod("/msk", 0o700)
+            su.setfacl("/msk", spec="user:bob:r--,mask::r--")
+            su.setfacl("/msk", spec="user:carol:rwx")  # mask must recalc
+            carol.mkdir("/msk/w")  # write works: not limited by stale r--
