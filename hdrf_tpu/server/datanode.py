@@ -275,7 +275,9 @@ class DataNode:
                 self.tokens.verify(fields.get("token"), fields["block_id"], "r")
                 meta = self.replicas.get_meta(fields["block_id"])
                 send_frame(sock, {"length": meta.logical_len if meta else -1,
-                                  "gen_stamp": meta.gen_stamp if meta else -1})
+                                  "gen_stamp": meta.gen_stamp if meta else -1,
+                                  "rbw": self.replicas.is_rbw(
+                                      fields["block_id"])})
             elif op == "truncate_replica":
                 self.tokens.verify(fields.get("token"), fields["block_id"], "w")
                 ok = self.replicas.truncate_replica(
@@ -426,6 +428,13 @@ class DataNode:
                 else:
                     r = self._peer_call(tuple(peer["addr"]), "replica_info",
                                         block_id=bid, token=token)
+                if r.get("rbw"):
+                    # an in-flight writer (or its teardown persist) is
+                    # still running on this peer: abort the round — the
+                    # NN re-dispatches shortly and the replica will have
+                    # settled (initReplicaRecovery's stopWriter analog)
+                    _M.incr("block_recovery_rbw_aborts")
+                    return
                 if r.get("length", -1) >= 0:
                     infos[dn_id] = (r.get("gen_stamp", 0), r["length"])
             except (OSError, ConnectionError, IOError):

@@ -60,6 +60,11 @@ class BlockInfo:
     path: str
     # serving replicas: DNs holding the CURRENT generation
     locations: set[str] = field(default_factory=set)  # dn_ids
+    # the allocation's intended pipeline (BlockInfoUnderConstruction
+    # .expectedLocations): lease recovery queries these DNs DIRECTLY
+    # instead of racing their asynchronous IBRs — soft state, rebuilt
+    # from reports after an NN restart
+    expected: list[str] = field(default_factory=list)
     # every live replica ever reported, any generation: dn_id ->
     # (gen_stamp, length).  This is what lease recovery consults — an IBR
     # must never fix a UC block's length (first-reporter-wins would violate
@@ -1064,6 +1069,7 @@ class NameNode:
             if not targets:
                 raise IOError("no datanodes available")
             self._log(["add_block", path, bid, gs])
+            self._blocks[bid].expected = [d.dn_id for d in targets]
             self._charge_alloc(path, bid, self.config.block_size)
             _M.incr("add_block")
             return {"block_id": bid, "gen_stamp": gs, "scheme": node.scheme,
@@ -1149,6 +1155,7 @@ class NameNode:
             if not targets:
                 raise IOError("no datanodes available")
             self._log(["bump_block", path, bid, new_gs])
+            info.expected = [d.dn_id for d in targets]
             return {"block_id": bid, "gen_stamp": new_gs,
                     "scheme": node.scheme,
                     "token": (self._tokens.mint(bid, "w")
@@ -2442,10 +2449,18 @@ class NameNode:
         info = self._blocks.get(last) if last is not None else None
         if info is not None and info.length < 0:
             now = time.monotonic()
-            live = sorted(d for d in info.reported if d in self._datanodes)
+            # candidates = reporters + the allocation's intended pipeline:
+            # recovery must not race the async IBRs of a DN that holds a
+            # replica but hasn't reported yet (it would sync to a PARTIAL
+            # peer set — possibly one replica's length, not the min)
+            live = sorted({d for d in (set(info.reported)
+                                       | set(info.expected))
+                           if d in self._datanodes})
             lens = {v for d, v in info.reported.items()
                     if d in self._datanodes}
-            if live and len(lens) == 1 and \
+            reported_live = {d for d in info.reported
+                             if d in self._datanodes}
+            if live and set(live) <= reported_live and len(lens) == 1 and \
                     next(iter(lens))[0] == info.gen_stamp:
                 # every live replica is at the current generation and they
                 # agree on length: nothing to sync — complete directly (the
@@ -2453,7 +2468,7 @@ class NameNode:
                 # internalReleaseLease); _resolved_length picks the agreed
                 # value below
                 self._recovery_grace.pop(last, None)
-            elif live:
+            elif live and reported_live:
                 self._recovery_grace.pop(last, None)
                 if now < self._pending_recovery.get(last, 0):
                     return False  # a recovery is already in flight
@@ -2465,7 +2480,9 @@ class NameNode:
                 # stamp in the reference too).
                 rec_gs = self._gen_stamp
                 self._log(["bump_block", path, last, rec_gs])
-                self._pending_recovery[last] = now + 30.0
+                # retry window: a recovery aborted by an in-flight RBW
+                # (writer not torn down yet) re-dispatches quickly
+                self._pending_recovery[last] = now + 5.0
                 primary = self._datanodes[live[0]]
                 primary.commands.append({
                     "cmd": "recover_block", "path": path, "block_id": last,

@@ -173,6 +173,13 @@ class ReplicaStore:
         with self._lock:
             return self._replicas.get(block_id)
 
+    def is_rbw(self, block_id: int) -> bool:
+        """An open in-flight writer exists (replica-being-written): block
+        recovery must not conclude "no replica" while the pipeline is still
+        alive or its teardown persist is in progress."""
+        with self._lock:
+            return block_id in self._rbw
+
     def length(self, block_id: int) -> int:
         """Logical length — authoritative from metadata, never from file size.
         Replaces the patched `FsDatasetImpl.getLength` (:735-761)."""

@@ -73,6 +73,10 @@ class SimulatedReplicaStore:
     def get_meta(self, block_id: int) -> BlockMeta | None:
         return self._meta.get(block_id)
 
+    def is_rbw(self, block_id: int) -> bool:
+        with self._lock:
+            return block_id in self._rbw
+
     def length(self, block_id: int) -> int:
         return self._meta[block_id].logical_len  # KeyError like the real store
 

@@ -61,7 +61,11 @@ class TestNamespace:
         register(nn)
         nn.rpc_create("/f", client="c1")
         a = nn.rpc_add_block("/f", client="c1")
-        nn.rpc_block_received("dn-0", a["block_id"], 42)  # DN reported length
+        bid = a["block_id"]
+        # ALL expected pipeline DNs report the same length: the consistent
+        # fast path completes without a recovery round trip
+        for t in a["targets"]:
+            nn.rpc_block_received(t["dn_id"], bid, 42)
         nn._leases.expiry_s = -1  # force expiry
         nn._leases.renew_all("c1")
         nn._recover_leases()
@@ -330,8 +334,18 @@ class TestSafemodeAndDecommission:
         register(nn)
         nn.rpc_create("/rl", client="c1")
         a = nn.rpc_add_block("/rl", client="c1")
-        nn.rpc_block_received("dn-0", a["block_id"], 42)
-        # writer vanishes without complete(); admin forces recovery
+        bid = a["block_id"]
+        # only ONE of the expected pipeline DNs has reported: recovery must
+        # NOT complete from a partial peer set — it dispatches a length-sync
+        # to the primary and waits for commitBlockSynchronization
+        nn.rpc_block_received(a["targets"][0]["dn_id"], bid, 42)
+        assert nn.rpc_recover_lease("/rl") is False
+        primary = nn._datanodes[a["targets"][0]["dn_id"]]
+        assert any(c["cmd"] == "recover_block" for c in primary.commands)
+        # the primary reports the synced min length
+        assert nn.rpc_commit_block_sync(
+            "/rl", bid, 42, [a["targets"][0]["dn_id"]],
+            gen_stamp=nn._blocks[bid].gen_stamp)
         assert nn.rpc_recover_lease("/rl") is True
         st = nn.rpc_stat("/rl")
         assert st["complete"] and st["length"] == 42
