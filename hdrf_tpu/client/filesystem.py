@@ -63,13 +63,24 @@ class HdrfClient:
         """NameNode RPC with the client's delegation token and caller
         identity attached (the UGI-token-selector analog: every call
         authenticates — and is permission-checked — when the cluster
-        requires it)."""
+        requires it).  Paths through symlinks answer SymlinkRedirect with
+        the resolved path; the client retries, bounded (the reference's
+        UnresolvedPathException client-side resolution)."""
+        from hdrf_tpu.proto.rpc import RpcError
+
         if self._dtoken is not None:
             kw["_dtoken"] = self._dtoken
         kw["_user"] = self.user
         if self.groups:
             kw["_groups"] = self.groups
-        return self._nn.call(method, **kw)
+        for _ in range(16):
+            try:
+                return self._nn.call(method, **kw)
+            except RpcError as e:
+                if e.error != "SymlinkRedirect" or "path" not in kw:
+                    raise
+                kw["path"] = e.message
+        raise IOError("too many levels of symbolic links")
 
     def renew_delegation_token(self) -> float:
         return self._call("renew_delegation_token", token=self._dtoken)
@@ -165,6 +176,27 @@ class HdrfClient:
 
     def datanode_report(self) -> list[dict]:
         return self._call("datanode_report")
+
+    # ------------------------- storage policy / replication / times / links
+
+    def set_storage_policy(self, path: str, policy: str) -> bool:
+        return self._call("set_storage_policy", path=path, policy=policy)
+
+    def get_storage_policy(self, path: str) -> dict:
+        return self._call("get_storage_policy", path=path)
+
+    def set_replication(self, path: str, replication: int) -> bool:
+        return self._call("set_replication", path=path,
+                          replication=replication)
+
+    def set_times(self, path: str, mtime: float = -1.0) -> bool:
+        return self._call("set_times", path=path, mtime=mtime)
+
+    def concat(self, dst: str, srcs: list[str]) -> bool:
+        return self._call("concat", dst=dst, srcs=srcs)
+
+    def create_symlink(self, link: str, target: str) -> bool:
+        return self._call("create_symlink", link=link, target=target)
 
     # -------------------------------------- permissions / ACLs / xattrs
 

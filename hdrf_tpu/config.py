@@ -129,6 +129,10 @@ class DataNodeConfig:
     data_dir: str = "/tmp/hdrf/data"
     # Topology label for rack-aware placement (net.topology mapping analog).
     rack: str = "/default-rack"
+    # This DN's storage type (StorageType enum analog: DISK/SSD/ARCHIVE/
+    # RAM_DISK).  One volume per DN by design (PARITY.md), so the type is
+    # per-node; storage POLICIES on paths select across nodes.
+    storage_type: str = "DISK"
     # Packet size on the data-transfer wire (reference default 64 KB).
     packet_size: int = 64 * 1024
     heartbeat_interval_s: float = 1.0

@@ -317,7 +317,8 @@ class DataNode:
             try:
                 resp = c.call("register_datanode", dn_id=self.dn_id,
                               addr=list(self.addr), sc_path=self._sc.path,
-                              rack=self.config.rack)
+                              rack=self.config.rack,
+                              storage_type=self.config.storage_type)
                 if resp.get("block_keys"):
                     self.tokens.update_keys(resp["block_keys"])
                 self._send_block_report(c)

@@ -75,6 +75,22 @@ class BlockInfo:
 
 
 @dataclass
+class SymNode:
+    """Symbolic link inode (INodeSymlink analog).  Resolution is
+    CLIENT-side, as in the reference: the NN answers a path touching a
+    symlink with a SymlinkRedirect error carrying the resolved path, and
+    the client retries (UnresolvedPathException / FileContext retry)."""
+
+    target: str
+    attrs: Attrs = field(default_factory=lambda: Attrs(
+        "hdrf", "supergroup", 0o777))
+
+
+class SymlinkRedirect(Exception):
+    """Raised mid-resolution; the message IS the resolved path."""
+
+
+@dataclass
 class GroupInfo:
     """EC block group: k+m internal blocks striped over distinct DNs
     (the BlockInfoStriped / block-group analog)."""
@@ -93,6 +109,7 @@ class DatanodeInfo:
     stats: dict = field(default_factory=dict)
     sc_path: str | None = None  # short-circuit unix socket (co-located reads)
     rack: str = "/default-rack"
+    storage_type: str = "DISK"  # StorageType analog (DISK/SSD/ARCHIVE/...)
 
 
 class LeaseManager:
@@ -312,6 +329,8 @@ class NameNode:
                     out[name] = ["f", child.replication, child.scheme,
                                  child.blocks, child.complete, child.mtime,
                                  child.ec, child.attrs.pack()]
+                elif isinstance(child, SymNode):
+                    out[name] = ["l", child.target, child.attrs.pack()]
                 else:
                     out[name] = ["d", walk(child),
                                  child.attrs.pack()
@@ -343,6 +362,8 @@ class NameNode:
                         v[6] if len(v) > 6 else None,
                         Attrs.unpack(v[7] if len(v) > 7 else None,
                                      mode=0o644))
+                elif v[0] == "l":
+                    out[name] = SymNode(v[1], Attrs.unpack(v[2]))
                 else:
                     d = walk(v[1])
                     d.attrs = Attrs.unpack(v[2] if len(v) > 2 else None)
@@ -475,6 +496,42 @@ class NameNode:
             self._dtokens.apply_renew(rec[1], rec[2])
         elif op == "dt_cancel":
             self._dtokens.apply_cancel(rec[1])
+        elif op == "setpolicy":
+            self._node_attrs(self._resolve(rec[1])).policy = rec[2] or None
+        elif op == "setrepl":
+            node = self._file(rec[1])
+            node.replication = rec[2]
+        elif op == "settimes":
+            node = self._file(rec[1])
+            if rec[2] >= 0:
+                node.mtime = rec[2]
+        elif op == "concat":
+            _, dst, srcs = rec
+            dnode = self._file(dst)
+            for sp in srcs:
+                snode = self._file(sp)
+                dnode.blocks.extend(snode.blocks)
+                dpath = "/" + "/".join(self._parts(dst))
+                for bid in snode.blocks:
+                    if bid in self._blocks:
+                        self._blocks[bid].path = dpath
+                    grp = self._groups.get(bid)
+                    if grp is not None:
+                        for b in grp.bids:
+                            if b in self._blocks:
+                                self._blocks[b].path = dpath
+                snode.blocks = []
+                parent, name = self._parent_of(sp)
+                parent.pop(name, None)
+                self._leases.drop(sp)
+            dnode.mtime = time.time()
+        elif op == "symlink":
+            _, link, target, *rest = rec
+            parent, name = self._parent_of(link, create=True,
+                                           user=rest[0] if rest else None)
+            parent[name] = SymNode(target, Attrs(
+                rest[0] if rest else self._superuser,
+                "supergroup", 0o777))
         elif op == "setperm":
             self._node_attrs(self._resolve(rec[1])).mode = rec[2]
         elif op == "setowner":
@@ -531,6 +588,17 @@ class NameNode:
                 u = self._qusage.get(r)
                 if u is not None:
                     u[1] += add
+        elif op == "symlink":
+            for r, _ in self._quota_roots_of(rec[1]):
+                u = self._qusage.get(r)
+                if u is not None:
+                    u[0] += 1
+                else:
+                    self._qusage[r] = None
+        elif op == "concat":
+            for path in [rec[1], *rec[2]]:
+                for r, _ in self._quota_roots_of(path):
+                    self._qusage[r] = None
         elif op in ("delete", "rename", "delete_snapshot", "truncate"):
             # truncate included: it SHRINKS usage (dropped whole blocks +
             # the cut boundary block), which the incremental paths never
@@ -670,16 +738,30 @@ class NameNode:
         tailer.start()  # the running monitor loop exits on its role check
         _M.incr("demotions")
 
+    @staticmethod
+    def _link_redirect(target: str, at: list[str], rest: list[str]):
+        """Raise SymlinkRedirect for a link hit at path prefix ``at`` with
+        remaining components ``rest``.  Relative targets resolve against
+        the LINK'S PARENT directory (POSIX), not the root."""
+        tgt = target.rstrip("/")
+        if not tgt.startswith("/"):
+            tgt = "/" + "/".join(at[:-1] + [tgt]) if len(at) > 1 \
+                else "/" + tgt
+        raise SymlinkRedirect(tgt + ("/" + "/".join(rest) if rest else ""))
+
     def _peek_parent(self, path: str) -> tuple[dict | None, str]:
         """Non-mutating walk to ``path``'s parent: raises if a component is a
         file; returns (None, name) when intermediate dirs don't exist yet
         (the apply will create them)."""
         parts = self._parts(path)
         node: Any = self._root
-        for p in parts[:-1]:
+        for i, p in enumerate(parts[:-1]):
             child = node.get(p)
             if child is None:
                 return None, parts[-1]
+            if isinstance(child, SymNode):
+                self._link_redirect(child.target, parts[:i + 1],
+                                    parts[i + 1:])
             if isinstance(child, FileNode):
                 raise NotADirectoryError(f"{p} in {path} is a file")
             node = child
@@ -703,9 +785,9 @@ class NameNode:
             self._file(rec[1])
         elif op == "delete":
             self._parent_of(rec[1])
-            self._resolve(rec[1])
+            self._resolve(rec[1], follow_leaf=False)
         elif op == "rename":
-            self._resolve(rec[1])
+            self._resolve(rec[1], follow_leaf=False)
             dparent, dname = self._peek_parent(rec[2])
             if dparent is not None and dname in dparent:
                 raise FileExistsError(rec[2])
@@ -725,8 +807,32 @@ class NameNode:
         elif op == "set_quota":
             if not isinstance(self._resolve(rec[1]), dict):
                 raise NotADirectoryError(rec[1])
-        elif op in ("setperm", "setowner", "setacl", "setxattr", "rmxattr"):
+        elif op in ("setperm", "setowner", "setacl", "setxattr", "rmxattr",
+                    "setpolicy"):
             self._resolve(rec[1])
+        elif op in ("setrepl", "settimes"):
+            self._file(rec[1])
+        elif op == "concat":
+            dnode = self._file(rec[1])
+            if not dnode.complete or dnode.ec:
+                raise IOError(f"concat target {rec[1]} must be a complete "
+                              "non-EC file")
+            seen = {"/" + "/".join(self._parts(rec[1]))}
+            for sp in rec[2]:
+                p = "/" + "/".join(self._parts(sp))
+                if p in seen:
+                    raise ValueError(f"duplicate path {sp} in concat")
+                seen.add(p)
+                snode = self._file(sp)
+                if not snode.complete or snode.ec:
+                    raise IOError(f"concat source {sp} must be a complete "
+                                  "non-EC file")
+                if snode.scheme != dnode.scheme:
+                    raise IOError("concat across reduction schemes")
+        elif op == "symlink":
+            parent, name = self._peek_parent(rec[1])
+            if parent is not None and name in parent:
+                raise FileExistsError(rec[1])
 
     # ------------------------------------------------------- tree utilities
 
@@ -741,7 +847,7 @@ class NameNode:
                    user: str | None = None) -> tuple[dict, str]:
         parts = self._parts(path)
         node = self._root
-        for p in parts[:-1]:
+        for i, p in enumerate(parts[:-1]):
             child = node.get(p)
             if child is None:
                 if not create:
@@ -749,6 +855,9 @@ class NameNode:
                 child = node[p] = DirNode(attrs=perm.inherit_attrs(
                     self._dir_attrs(node), user or self._superuser, None,
                     is_dir=True))
+            if isinstance(child, SymNode):
+                self._link_redirect(child.target, parts[:i + 1],
+                                    parts[i + 1:])
             if isinstance(child, FileNode):
                 raise NotADirectoryError(f"{p} in {path} is a file")
             node = child
@@ -827,17 +936,21 @@ class NameNode:
                 raise PermissionError(
                     f"permission denied: user={user} on {path}")
 
-    def _resolve(self, path: str) -> Any:
+    def _resolve(self, path: str, follow_leaf: bool = True) -> Any:
         parts = [p for p in path.split("/") if p]
         if ".snapshot" in parts:
             return self._resolve_snapshot(parts)
         node: Any = self._root
-        for p in parts:
-            if isinstance(node, FileNode):
+        for i, p in enumerate(parts):
+            if isinstance(node, (FileNode, SymNode)):
                 raise NotADirectoryError(path)
             if p not in node:
                 raise FileNotFoundError(path)
             node = node[p]
+            if isinstance(node, SymNode) and (follow_leaf
+                                              or i < len(parts) - 1):
+                self._link_redirect(node.target, parts[:i + 1],
+                                    parts[i + 1:])
         return node
 
     def _resolve_snapshot(self, parts: list[str]) -> Any:
@@ -855,7 +968,7 @@ class NameNode:
             raise FileNotFoundError(f"no snapshot {rest[0]} of {droot}")
         node = self._thaw(snaps[rest[0]])
         for p in rest[1:]:
-            if isinstance(node, FileNode):
+            if isinstance(node, (FileNode, SymNode)):
                 raise NotADirectoryError("/".join(parts))
             if p not in node:
                 raise FileNotFoundError("/".join(parts))
@@ -924,6 +1037,8 @@ class NameNode:
         if isinstance(node, FileNode):
             return ["f", node.replication, node.scheme, list(node.blocks),
                     node.complete, node.mtime, node.ec, node.attrs.pack()]
+        if isinstance(node, SymNode):
+            return ["l", node.target, node.attrs.pack()]
         return ["d", {name: NameNode._freeze(child)
                       for name, child in node.items()},
                 node.attrs.pack() if isinstance(node, DirNode) else None]
@@ -936,6 +1051,8 @@ class NameNode:
                             v[6] if len(v) > 6 else None,
                             Attrs.unpack(v[7] if len(v) > 7 else None,
                                          mode=0o644))
+        if v[0] == "l":
+            return SymNode(v[1], Attrs.unpack(v[2]))
         d = DirNode({name: self._thaw(child) for name, child in v[1].items()})
         d.attrs = Attrs.unpack(v[2] if len(v) > 2 else None)
         return d
@@ -944,6 +1061,8 @@ class NameNode:
         """(block ids, group ids) referenced by a frozen tree."""
         bids: set[int] = set()
         gids: set[int] = set()
+        if v[0] == "l":
+            return bids, gids
         if v[0] == "f":
             for gb in v[3]:
                 grp = self._groups.get(gb)
@@ -1061,11 +1180,13 @@ class NameNode:
         """Allocate the next block + choose target DNs (addBlock RPC ->
         BlockManager placement, DataStreamer.java:1655's nextBlockOutputStream)."""
         with self._lock:
+            node = self._file(path)  # resolves symlinks (redirect) FIRST —
+            # the lease is keyed by the resolved path the create used
             self._leases.check(path, client)
-            node = self._file(path)
             self._check_space_quota(path, self.config.block_size)
             bid, gs = self._next_block_id, self._gen_stamp
-            targets = self._choose_targets(node.replication, exclude=set())
+            targets = self._choose_targets(node.replication, exclude=set(),
+                                           policy=self._policy_of(path))
             if not targets:
                 raise IOError("no datanodes available")
             self._log(["add_block", path, bid, gs])
@@ -1084,13 +1205,14 @@ class NameNode:
         from hdrf_tpu.ops import rs
 
         with self._lock:
-            self._leases.check(path, client)
             node = self._file(path)
+            self._leases.check(path, client)
             if not node.ec:
                 raise ValueError(f"{path} is not an EC file")
             k, m, cell = rs.parse_policy(node.ec)
             self._check_space_quota(path, k * self.config.block_size)
-            targets = self._choose_targets(k + m, exclude=set())
+            targets = self._choose_targets(k + m, exclude=set(),
+                                           policy=self._policy_of(path))
             if len(targets) < k + m:
                 # fewer DNs than shards: wrap around (degraded placement;
                 # real deployments require >= k+m racks/nodes)
@@ -1146,12 +1268,13 @@ class NameNode:
         stale gen stamp are invalidated (the reference's gen-stamp
         supersede after pipeline recovery)."""
         with self._lock:
-            self._leases.check(path, client)
             node = self._file(path)
+            self._leases.check(path, client)
             bid = node.blocks[-1]
             info = self._blocks[bid]
             new_gs = self._gen_stamp + 1
-            targets = self._choose_targets(node.replication, exclude=set())
+            targets = self._choose_targets(node.replication, exclude=set(),
+                                           policy=self._policy_of(path))
             if not targets:
                 raise IOError("no datanodes available")
             self._log(["bump_block", path, bid, new_gs])
@@ -1189,6 +1312,7 @@ class NameNode:
 
     def rpc_abandon_block(self, path: str, client: str, block_id: int) -> bool:
         with self._lock:
+            self._file(path)  # symlink redirect before the lease check
             self._leases.check(path, client)
             self._log(["abandon_block", path, block_id])
             return True
@@ -1200,6 +1324,7 @@ class NameNode:
         reference (DFSClient) exists for exactly this, with the NN holding
         completion until minimal replication is met."""
         with self._lock:
+            self._file(path)  # symlink redirect before the lease check
             self._leases.check(path, client)
             for bid in block_lengths:
                 bids = (self._groups[bid].bids if bid in self._groups
@@ -1281,7 +1406,7 @@ class NameNode:
         with self._lock:
             self._check_access(path, parent_want=perm.WRITE)
             try:
-                self._resolve(path)
+                self._resolve(path, follow_leaf=False)  # delete the LINK
             except FileNotFoundError:
                 return False
             self._log(["delete", path])
@@ -1292,7 +1417,7 @@ class NameNode:
         with self._lock:
             self._check_access(src, parent_want=perm.WRITE)
             self._check_access(dst, parent_want=perm.WRITE)
-            self._resolve(src)
+            self._resolve(src, follow_leaf=False)
             s = "/" + "/".join(self._parts(src))
             d = "/" + "/".join(p for p in dst.split("/") if p)
             if d == s or d.startswith(s + "/"):
@@ -1330,9 +1455,137 @@ class NameNode:
                     "complete": node.complete, "blocks": len(node.blocks),
                     "mtime": node.mtime, "ec": node.ec,
                     "owner": a.owner, "group": a.group, "mode": a.mode}
+        if isinstance(node, SymNode):
+            a = node.attrs
+            return {"name": name, "type": "symlink", "target": node.target,
+                    "owner": a.owner, "group": a.group, "mode": a.mode}
         a = self._dir_attrs(node)
         return {"name": name, "type": "dir", "children": len(node),
                 "owner": a.owner, "group": a.group, "mode": a.mode}
+
+    # ---------------------- storage policies / replication / times / concat
+
+    def rpc_set_storage_policy(self, path: str, policy: str) -> bool:
+        """setStoragePolicy (FSDirAttrOp analog): per-path policy selecting
+        replica storage types; '' clears (inherit)."""
+        with self._lock:
+            if policy and policy not in self.STORAGE_POLICIES:
+                raise ValueError(f"unknown storage policy {policy!r}; "
+                                 f"known: {sorted(self.STORAGE_POLICIES)}")
+            self._check_access(path, owner_only=True)
+            self._resolve(path)
+            self._log(["setpolicy", path, policy])
+            _M.incr("setpolicy")
+            return True
+
+    def rpc_get_storage_policy(self, path: str) -> dict:
+        with self._lock:
+            self._check_access(path)
+            node = self._resolve(path)
+            a = getattr(node, "attrs", None)
+            return {"policy": a.policy if a else None,
+                    "effective": self._policy_of(path)}
+
+    def rpc_set_replication(self, path: str, replication: int) -> bool:
+        """setReplication (FSDirAttrOp.setReplication): the redundancy
+        monitor converges live replica counts to the new target (adds via
+        re-replication, trims via excess pruning)."""
+        with self._lock:
+            if replication < 1:
+                raise ValueError("replication must be >= 1")
+            self._check_access(path, want=perm.WRITE)
+            node = self._file(path)
+            if node.ec:
+                raise ValueError("EC files carry no replication factor")
+            self._log(["setrepl", path, replication])
+            _M.incr("setrepl")
+            return True
+
+    def rpc_set_times(self, path: str, mtime: float = -1.0) -> bool:
+        """setTimes analog (atime tracking is not kept — the reference
+        only persists atime at precision intervals; we document mtime)."""
+        with self._lock:
+            self._check_access(path, want=perm.WRITE)
+            self._file(path)
+            self._log(["settimes", path, float(mtime)])
+            return True
+
+    def rpc_concat(self, dst: str, srcs: list[str]) -> bool:
+        """concat (FSDirConcatOp.java:49): move srcs' blocks onto dst and
+        delete the src inodes — pure namespace surgery, no data motion.
+        Unlike the reference, interior partial blocks are legal here: reads
+        walk per-block logical lengths, so no full-block constraint."""
+        with self._lock:
+            self._check_access(dst, want=perm.WRITE)
+            for sp in srcs:
+                self._check_access(sp, want=perm.WRITE,
+                                   parent_want=perm.WRITE)
+            self._log(["concat", dst, list(srcs)])
+            _M.incr("concat")
+            return True
+
+    def rpc_create_symlink(self, link: str, target: str) -> bool:
+        """createSymlink (FSDirSymlinkOp.java:34).  Resolution is client
+        side: any path through a link answers SymlinkRedirect and the
+        client retries with the resolved path."""
+        with self._lock:
+            self._check_access(link, parent_want=perm.WRITE)
+            self._check_ns_quota(link)
+            self._log(["symlink", link, target,
+                       perm.caller()[0] or self._superuser])
+            _M.incr("symlinks_created")
+            return True
+
+    def rpc_policy_violations(self, limit: int = 100) -> list[dict]:
+        """Mover support (Mover.java:70 analog): blocks whose live replica
+        storage types don't satisfy their path's effective policy, each
+        with a proposed (from_dn, to_dn) migration.  The mover executes
+        them via rpc_move_block and re-polls until empty."""
+        with self._lock:
+            out: list[dict] = []
+            now = time.monotonic()
+            live_dns = {d.dn_id: d for d in self._datanodes.values()
+                        if now - d.last_heartbeat
+                        < self.config.dead_node_interval_s}
+            for info in self._blocks.values():
+                if len(out) >= limit:
+                    break
+                node = self._try_file(info.path)
+                if node is None or not node.complete or info.length < 0:
+                    continue
+                if info.block_id in self._pending_moves:
+                    continue
+                policy = self._policy_of(info.path)
+                locs = [d for d in info.locations if d in live_dns]
+                if not locs:
+                    continue
+                want = self._types_for(policy, len(locs))
+                # multiset matching: each replica consumes one want slot of
+                # its type; replicas that find no slot are SURPLUS (wrong),
+                # and the unconsumed slots are what's still needed — a
+                # plain membership test misses multi-type policies (warm
+                # with every replica on DISK has need but no "not in want"
+                # replica)
+                need = list(want)
+                wrong = []
+                for d in locs:
+                    t = live_dns[d].storage_type
+                    if t in need:
+                        need.remove(t)
+                    else:
+                        wrong.append(d)
+                if not need:
+                    continue
+                cands = [d for d in live_dns.values()
+                         if d.storage_type == need[0]
+                         and d.dn_id not in info.locations
+                         and d.dn_id not in self._decommissioning]
+                if wrong and cands:
+                    out.append({"block_id": info.block_id,
+                                "from_dn": wrong[0],
+                                "to_dn": cands[0].dn_id,
+                                "policy": policy})
+            return out
 
     # ------------------------------------------- permissions / ACLs / xattrs
 
@@ -1601,11 +1854,12 @@ class NameNode:
 
     def rpc_register_datanode(self, dn_id: str, addr: list,
                               sc_path: str | None = None,
-                              rack: str = "/default-rack") -> dict:
+                              rack: str = "/default-rack",
+                              storage_type: str = "DISK") -> dict:
         with self._lock:
             self._datanodes[dn_id] = DatanodeInfo(
                 dn_id, (addr[0], addr[1]), last_heartbeat=time.monotonic(),
-                sc_path=sc_path, rack=rack)
+                sc_path=sc_path, rack=rack, storage_type=storage_type)
             _M.incr("dn_registered")
             keys = None
             if self._tokens is not None:
@@ -1893,27 +2147,77 @@ class NameNode:
 
     # ---------------------------------------------------------- block mgmt
 
-    def _choose_targets(self, n: int, exclude: set[str]) -> list[DatanodeInfo]:
-        """Rack-aware placement (BlockPlacementPolicyDefault-lite): shuffle
-        live DNs, then round-robin across racks so replicas/shards spread
-        over failure domains before doubling up within one."""
+    # Storage policies (BlockStoragePolicySuite analog): preferred storage
+    # type per replica index; fallback = any type when the preferred ones
+    # are unavailable (the reference's policy fallback chain).
+    STORAGE_POLICIES = {
+        "hot": ["DISK"],
+        "warm": ["DISK", "ARCHIVE"],    # first replica DISK, rest ARCHIVE
+        "cold": ["ARCHIVE"],
+        "all_ssd": ["SSD"],
+        "one_ssd": ["SSD", "DISK"],
+        "lazy_persist": ["RAM_DISK", "DISK"],
+    }
+
+    def _policy_of(self, path: str) -> str:
+        """Effective storage policy: the nearest ancestor's explicit
+        policy, default 'hot'."""
+        node: Any = self._root
+        policy = self._root.attrs.policy
+        for p in [q for q in path.split("/") if q]:
+            if not isinstance(node, dict):
+                break
+            node = node.get(p)
+            if node is None:
+                break
+            a = getattr(node, "attrs", None)
+            if a is not None and a.policy:
+                policy = a.policy
+        return policy or "hot"
+
+    def _types_for(self, policy: str, n: int) -> list[str]:
+        pref = self.STORAGE_POLICIES.get(policy, ["DISK"])
+        return [pref[min(i, len(pref) - 1)] for i in range(n)]
+
+    def _choose_targets(self, n: int, exclude: set[str],
+                        policy: str | None = None) -> list[DatanodeInfo]:
+        """Rack- and storage-policy-aware placement
+        (BlockPlacementPolicyDefault-lite): per replica index the policy's
+        preferred storage type is satisfied first, falling back to any
+        live node; within a type class, round-robin across racks so
+        replicas spread over failure domains before doubling up."""
         now = time.monotonic()
         live = [d for d in self._datanodes.values()
                 if now - d.last_heartbeat < self.config.dead_node_interval_s
                 and d.dn_id not in exclude
                 and d.dn_id not in self._decommissioning]
         random.shuffle(live)
-        by_rack: dict[str, list[DatanodeInfo]] = {}
-        for d in live:
-            by_rack.setdefault(d.rack, []).append(d)
-        racks = list(by_rack.values())
-        random.shuffle(racks)
+        wanted_types = self._types_for(policy or "hot", n)
+
+        def pick(pool: list[DatanodeInfo], k: int,
+                 chosen: list[DatanodeInfo]) -> None:
+            by_rack: dict[str, list[DatanodeInfo]] = {}
+            used = {c.dn_id for c in chosen}
+            for d in pool:
+                if d.dn_id not in used:
+                    by_rack.setdefault(d.rack, []).append(d)
+            racks = list(by_rack.values())
+            random.shuffle(racks)
+            while k > 0 and any(racks):
+                for r in racks:
+                    if r and k > 0:
+                        chosen.append(r.pop())
+                        k -= 1
+
         out: list[DatanodeInfo] = []
-        while len(out) < n and any(racks):
-            for r in racks:
-                if r and len(out) < n:
-                    out.append(r.pop())
-        return out
+        # policy pass: fill each type class from matching nodes
+        from collections import Counter
+
+        for stype, count in Counter(wanted_types).items():
+            pick([d for d in live if d.storage_type == stype], count, out)
+        if len(out) < n:  # fallback chain: any live node
+            pick(live, n - len(out), out)
+        return out[:n]
 
     # -------------------------------------------------------------------- HA
 

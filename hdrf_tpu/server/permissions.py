@@ -42,10 +42,14 @@ class Attrs:
     # Default ACL (directories only): entries new children inherit.
     dacl: list = field(default_factory=list)
     xattrs: dict = field(default_factory=dict)  # name -> bytes
+    # Storage policy name (hot/warm/cold/all_ssd/one_ssd) or None =
+    # inherit from the nearest ancestor (BlockStoragePolicySuite analog —
+    # the reference stores the policy id in the inode header).
+    policy: str | None = None
 
     def pack(self) -> list:
         return [self.owner, self.group, self.mode, self.acl, self.dacl,
-                {k: bytes(v) for k, v in self.xattrs.items()}]
+                {k: bytes(v) for k, v in self.xattrs.items()}, self.policy]
 
     @staticmethod
     def unpack(v: list | None, owner="hdrf", group="supergroup",
@@ -53,7 +57,8 @@ class Attrs:
         if not v:
             return Attrs(owner, group, mode)
         return Attrs(v[0], v[1], v[2], [list(e) for e in v[3]],
-                     [list(e) for e in v[4]], dict(v[5]))
+                     [list(e) for e in v[4]], dict(v[5]),
+                     v[6] if len(v) > 6 else None)
 
 
 class DirNode(dict):

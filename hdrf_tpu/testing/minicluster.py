@@ -25,16 +25,20 @@ class MiniCluster:
                  replication: int = 3, block_size: int = 1 << 20,
                  container_size: int = 1 << 22, heartbeat_s: float = 0.2,
                  dead_node_s: float = 1.5, ha: bool = False,
-                 journal_nodes: int = 0, secure: bool = False):
+                 journal_nodes: int = 0, secure: bool = False,
+                 storage_types: list[str] | None = None):
         """``journal_nodes`` > 0 boots that many JournalNodes and puts the
         edit log on the quorum (MiniQJMHACluster analog); each NN then gets
         its OWN meta_dir (only the shared-dir deployment shares one).
         ``secure`` turns on the whole security matrix: block tokens,
-        delegation-token-authenticated RPCs, and encrypted data transfer."""
+        delegation-token-authenticated RPCs, and encrypted data transfer.
+        ``storage_types`` assigns each DN a StorageType (DISK/SSD/ARCHIVE)
+        for storage-policy tests."""
         self.n_datanodes = n_datanodes
         self.ha = ha
         self.n_journal = journal_nodes
         self.secure = secure
+        self.storage_types = storage_types or []
         self._own_dir = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="hdrf-mini-")
         self.nn_config = NameNodeConfig(
@@ -107,6 +111,8 @@ class MiniCluster:
         cfg.reduction.container_size = self._dn_kw["container_size"]
         cfg.reduction.backend = "native"  # deterministic in tests
         cfg.encrypt_data_transfer = self.secure
+        if i < len(self.storage_types):
+            cfg.storage_type = self.storage_types[i]
         return DataNode(cfg, self.nn_addrs(), dn_id=f"dn-{i}")
 
     def stop(self) -> None:

@@ -10,6 +10,7 @@ dispatch; DFSAdmin, OfflineImageViewer / OfflineEditsViewer under
   dfs                      -ls -mkdir -put -get -cat -rm -mv -stat -du -count
                            -createSnapshot -deleteSnapshot -lsSnapshots
                            -chmod -chown -getfacl -setfacl -setfattr -getfattr
+  mover                    migrate replicas to satisfy storage policies
   dfsadmin                 -report -savenamespace -metrics -movblock
                            -allowSnapshot -setQuota -setSpaceQuota -clrQuota
                            -safemode -decommission -decommissionStatus
@@ -346,6 +347,28 @@ def cmd_balancer(args) -> int:
     return 0
 
 
+def cmd_mover(args) -> int:
+    """Mover (server/mover/Mover.java:70 analog): migrate replicas until
+    every block's storage types satisfy its path's effective policy.  The
+    NN proposes (from, to) legs; each rides the same rpc_move_block the
+    balancer uses (copy to target, invalidate source once reported)."""
+    with _client(args) as c:
+        total = 0
+        for _ in range(args.iterations):
+            moves = c._call("policy_violations", limit=args.batch)
+            if not moves:
+                print(f"storage policies satisfied ({total} moves)")
+                return 0
+            for mv in moves:
+                if c._call("move_block", block_id=mv["block_id"],
+                           from_dn=mv["from_dn"], to_dn=mv["to_dn"]):
+                    total += 1
+            print(f"scheduled {len(moves)} moves; waiting for settle")
+            time.sleep(args.wait_s)
+        print(f"iteration budget exhausted after {total} moves")
+        return 1
+
+
 # ---------------------------------------------------------------------- main
 
 def main(argv: list[str] | None = None) -> int:
@@ -389,6 +412,14 @@ def main(argv: list[str] | None = None) -> int:
     d = sub.add_parser("oev")
     d.add_argument("meta_dir")
     d.set_defaults(fn=cmd_oev)
+
+    d = sub.add_parser("mover")
+    d.add_argument("--namenode", required=True)
+    d.add_argument("--secure", action="store_true")
+    d.add_argument("--iterations", type=int, default=10)
+    d.add_argument("--batch", type=int, default=16)
+    d.add_argument("--wait-s", type=float, default=1.0)
+    d.set_defaults(fn=cmd_mover)
 
     d = sub.add_parser("balancer")
     d.add_argument("--namenode", required=True)

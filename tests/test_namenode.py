@@ -340,8 +340,8 @@ class TestSafemodeAndDecommission:
         # to the primary and waits for commitBlockSynchronization
         nn.rpc_block_received(a["targets"][0]["dn_id"], bid, 42)
         assert nn.rpc_recover_lease("/rl") is False
-        primary = nn._datanodes[a["targets"][0]["dn_id"]]
-        assert any(c["cmd"] == "recover_block" for c in primary.commands)
+        all_cmds = [c for d in nn._datanodes.values() for c in d.commands]
+        assert any(c["cmd"] == "recover_block" for c in all_cmds)
         # the primary reports the synced min length
         assert nn.rpc_commit_block_sync(
             "/rl", bid, 42, [a["targets"][0]["dn_id"]],

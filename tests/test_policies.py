@@ -1,0 +1,215 @@
+"""Storage policies + Mover, and the attr namespace ops: setReplication,
+setTimes, concat, symlinks (Mover.java:70, FSDirAttrOp, FSDirConcatOp.java:49,
+FSDirSymlinkOp.java:34 analogs)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from hdrf_tpu.testing.minicluster import MiniCluster
+
+RNG = np.random.default_rng(31)
+
+
+def _bytes(n):
+    return RNG.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+class TestStoragePolicies:
+    def test_policy_aware_placement(self):
+        """A 'cold' path places its replica on the ARCHIVE node."""
+        with MiniCluster(n_datanodes=3, replication=1, block_size=1 << 20,
+                         storage_types=["DISK", "DISK", "ARCHIVE"]) as mc:
+            nn = mc.namenode
+            with mc.client("w") as c:
+                c.mkdir("/cold")
+                c.set_storage_policy("/cold", "cold")
+                assert c.get_storage_policy("/cold")["effective"] == "cold"
+                for i in range(3):
+                    c.write(f"/cold/f{i}", _bytes(10_000))
+                for i in range(3):
+                    loc = c._call("get_block_locations", path=f"/cold/f{i}")
+                    for b in loc["blocks"]:
+                        for ld in b["locations"]:
+                            dn = nn._datanodes[ld["dn_id"]]
+                            assert dn.storage_type == "ARCHIVE"
+
+    def test_mover_migrates_replicas(self):
+        """Policy set AFTER writing: the mover moves the replica from the
+        hot (DISK) node to the ARCHIVE node."""
+        from hdrf_tpu.tools import cli
+
+        with MiniCluster(n_datanodes=2, replication=1, block_size=1 << 20,
+                         storage_types=["DISK", "ARCHIVE"]) as mc:
+            nn = mc.namenode
+            with mc.client("w") as c:
+                c.mkdir("/data")
+                c.write("/data/f", _bytes(50_000))  # hot default -> DISK
+                loc = c._call("get_block_locations", path="/data/f")
+                bid = loc["blocks"][0]["block_id"]
+                assert nn._datanodes[
+                    loc["blocks"][0]["locations"][0]["dn_id"]
+                ].storage_type == "DISK"
+                c.set_storage_policy("/data", "cold")
+                viol = c._call("policy_violations")
+                assert viol and viol[0]["block_id"] == bid
+                addr = f"{nn.addr[0]}:{nn.addr[1]}"
+                assert cli.main(["mover", "--namenode", addr,
+                                 "--iterations", "20",
+                                 "--wait-s", "0.3"]) == 0
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    loc = c._call("get_block_locations", path="/data/f")
+                    dns = [ld["dn_id"]
+                           for ld in loc["blocks"][0]["locations"]]
+                    if dns and all(nn._datanodes[d].storage_type ==
+                                   "ARCHIVE" for d in dns):
+                        break
+                    time.sleep(0.3)
+                else:
+                    pytest.fail("replica never moved to ARCHIVE")
+                assert c.read("/data/f")  # still readable after migration
+
+
+class TestAttrOps:
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        with MiniCluster(n_datanodes=3, replication=1,
+                         block_size=1 << 20) as mc:
+            yield mc
+
+    def test_set_replication_converges(self, cluster):
+        nn = cluster.namenode
+        with cluster.client("r") as c:
+            c.write("/sr/f", _bytes(20_000))
+            assert c.stat("/sr/f")["replication"] == 1
+            c.set_replication("/sr/f", 2)
+            assert c.stat("/sr/f")["replication"] == 2
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                loc = c._call("get_block_locations", path="/sr/f")
+                if len(loc["blocks"][0]["locations"]) == 2:
+                    break
+                time.sleep(0.3)
+            else:
+                pytest.fail("redundancy monitor never added the replica")
+
+    def test_set_times(self, cluster):
+        with cluster.client("t") as c:
+            c.write("/tm/f", b"x")
+            c.set_times("/tm/f", mtime=12345.0)
+            assert c.stat("/tm/f")["mtime"] == 12345.0
+
+    def test_concat(self, cluster):
+        with cluster.client("cc") as c:
+            parts = [_bytes(30_000) for _ in range(3)]
+            for i, p in enumerate(parts):
+                c.write(f"/cc/p{i}", p)
+            c.concat("/cc/p0", ["/cc/p1", "/cc/p2"])
+            assert c.read("/cc/p0") == b"".join(parts)
+            assert not c.exists("/cc/p1") and not c.exists("/cc/p2")
+            st = c.stat("/cc/p0")
+            assert st["length"] == 90_000 and st["blocks"] == 3
+
+    def test_concat_validation(self, cluster):
+        from hdrf_tpu.proto.rpc import RpcError
+
+        with cluster.client("cv") as c:
+            c.write("/cv/a", b"a" * 100)
+            c.write("/cv/b", b"b" * 100, scheme="dedup_lz4")
+            with pytest.raises(RpcError):
+                c.concat("/cv/a", ["/cv/b"])  # scheme mismatch
+            with pytest.raises(RpcError):
+                c.concat("/cv/a", ["/cv/a"])  # self-concat
+
+    def test_symlink_resolution(self, cluster):
+        with cluster.client("sl") as c:
+            data = _bytes(12_345)
+            c.write("/real/file", data)
+            c.create_symlink("/lnk", "/real")
+            # read THROUGH the link (client-side redirect retry)
+            assert c.read("/lnk/file") == data
+            assert c.stat("/lnk/file")["length"] == 12_345
+            # listing shows the link itself
+            ents = {e["name"]: e for e in c.ls("/")}
+            assert ents["lnk"]["type"] == "symlink"
+            assert ents["lnk"]["target"] == "/real"
+            # deleting the link leaves the target
+            assert c.delete("/lnk")
+            assert c.read("/real/file") == data
+
+    def test_symlink_to_file_and_dangling(self, cluster):
+        from hdrf_tpu.proto.rpc import RpcError
+
+        with cluster.client("sl2") as c:
+            c.write("/tgt", b"hello")
+            c.create_symlink("/ln2", "/tgt")
+            assert c.read("/ln2") == b"hello"
+            c.create_symlink("/dang", "/nowhere")
+            with pytest.raises((RpcError, IOError)):
+                c.read("/dang")
+
+
+class TestReviewHoles:
+    def test_write_through_symlinked_dir(self):
+        """create/mkdir UNDER a symlink redirect client-side too."""
+        with MiniCluster(n_datanodes=1, replication=1,
+                         block_size=1 << 20) as mc:
+            with mc.client("w") as c:
+                c.mkdir("/real")
+                c.create_symlink("/ln", "/real")
+                c.write("/ln/f", b"through-link")
+                assert c.read("/real/f") == b"through-link"
+                c.mkdir("/ln/sub")
+                assert c.exists("/real/sub")
+
+    def test_relative_symlink_target(self):
+        with MiniCluster(n_datanodes=1, replication=1,
+                         block_size=1 << 20) as mc:
+            with mc.client("w") as c:
+                c.write("/a/sub/f", b"rel")
+                c.create_symlink("/a/ln", "sub")  # relative to /a
+                assert c.read("/a/ln/f") == b"rel"
+
+    def test_warm_policy_all_disk_violation_detected(self):
+        """warm with every replica on DISK: the membership test missed it;
+        the multiset match must propose an ARCHIVE migration."""
+        with MiniCluster(n_datanodes=3, replication=2, block_size=1 << 20,
+                         storage_types=["DISK", "DISK", "ARCHIVE"]) as mc:
+            with mc.client("w") as c:
+                c.mkdir("/w")
+                c.write("/w/f", _bytes(10_000))  # hot -> both DISK
+                import time as _t
+                deadline = _t.time() + 10
+                while _t.time() < deadline:
+                    loc = c._call("get_block_locations", path="/w/f")
+                    if len(loc["blocks"][0]["locations"]) == 2:
+                        break
+                    _t.sleep(0.2)
+                c.set_storage_policy("/w", "warm")
+                viol = c._call("policy_violations")
+                assert viol, "warm violation must be detected"
+                assert mc.namenode._datanodes[
+                    viol[0]["to_dn"]].storage_type == "ARCHIVE"
+
+    def test_symlink_counts_against_ns_quota(self):
+        from hdrf_tpu.proto.rpc import RpcError
+
+        with MiniCluster(n_datanodes=1, replication=1,
+                         block_size=1 << 20) as mc:
+            with mc.client("q") as c:
+                c.mkdir("/qd")
+                c.set_quota("/qd", namespace_quota=2)  # dir itself + 1
+                c.create_symlink("/qd/l1", "/x")
+                with pytest.raises(RpcError):
+                    c.create_symlink("/qd/l2", "/y")
+
+    def test_root_storage_policy_roundtrip(self):
+        with MiniCluster(n_datanodes=1, replication=1,
+                         block_size=1 << 20) as mc:
+            with mc.client("r") as c:
+                c.set_storage_policy("/", "hot")
+                assert c.get_storage_policy("/")["effective"] == "hot"
