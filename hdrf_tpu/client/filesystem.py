@@ -77,9 +77,26 @@ class HdrfClient:
             try:
                 return self._nn.call(method, **kw)
             except RpcError as e:
-                if e.error != "SymlinkRedirect" or "path" not in kw:
+                if e.error != "SymlinkRedirect":
                     raise
-                kw["path"] = e.message
+                orig, _, resolved = e.message.partition("\n")
+
+                def norm(p):
+                    return "/" + "/".join(x for x in str(p).split("/") if x)
+
+                hit = False
+                for k, v in list(kw.items()):
+                    if isinstance(v, str) and not k.startswith("_") \
+                            and norm(v) == orig:
+                        kw[k] = resolved
+                        hit = True
+                    elif isinstance(v, list) and v and \
+                            all(isinstance(x, str) for x in v):
+                        kw[k] = [resolved if norm(x) == orig else x
+                                 for x in v]
+                        hit = hit or kw[k] != v
+                if not hit:
+                    raise
         raise IOError("too many levels of symbolic links")
 
     def renew_delegation_token(self) -> float:
@@ -176,6 +193,27 @@ class HdrfClient:
 
     def datanode_report(self) -> list[dict]:
         return self._call("datanode_report")
+
+    # ------------------------------------------------------ cache directives
+
+    def add_cache_pool(self, name: str, limit: int = -1) -> bool:
+        return self._call("add_cache_pool", name=name, limit=limit)
+
+    def remove_cache_pool(self, name: str) -> bool:
+        return self._call("remove_cache_pool", name=name)
+
+    def list_cache_pools(self) -> dict:
+        return self._call("list_cache_pools")
+
+    def add_cache_directive(self, path: str, pool: str) -> int:
+        return self._call("add_cache_directive", path=path, pool=pool)
+
+    def remove_cache_directive(self, directive_id: int) -> bool:
+        return self._call("remove_cache_directive",
+                          directive_id=directive_id)
+
+    def list_cache_directives(self) -> list[dict]:
+        return self._call("list_cache_directives")
 
     # ------------------------- storage policy / replication / times / links
 

@@ -135,6 +135,8 @@ class DataNodeConfig:
     storage_type: str = "DISK"
     # Packet size on the data-transfer wire (reference default 64 KB).
     packet_size: int = 64 * 1024
+    # Pinned replica cache budget (dfs.datanode.max.locked.memory analog).
+    cache_capacity: int = 64 * 1024 * 1024
     heartbeat_interval_s: float = 1.0
     block_report_interval_s: float = 30.0
     # Rolling replica verification cadence (BlockScanner analog); one block

@@ -38,6 +38,10 @@ class BlockSender:
                      length: int = -1) -> bytes:
         """Logical bytes of a block, whatever its stored form."""
         dn = self._dn
+        cached = dn.cache.get(block_id, offset, length)
+        if cached is not None:
+            _M.incr("cached_reads")
+            return cached  # pinned logical bytes: no disk, no reconstruction
         meta = dn.replicas.get_meta(block_id)
         if meta is None:
             raise KeyError(f"block {block_id} not on this datanode")

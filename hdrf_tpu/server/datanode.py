@@ -47,6 +47,57 @@ from hdrf_tpu.utils import fault_injection, metrics
 _M = metrics.registry("datanode")
 
 
+class PinnedCache:
+    """DN-side pinned replica cache (FsDatasetCache.java:67 analog).  The
+    reference mmaps + mlocks replica files; here the LOGICAL bytes are
+    pinned in RAM (covering reduced blocks too — a cached dedup'd block
+    skips reconstruction AND disk), bounded by a byte budget.  Pin/unpin
+    is NN-directed via DNA_CACHE/DNA_UNCACHE commands."""
+
+    def __init__(self, capacity: int):
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._data: dict[int, bytes] = {}
+        self._used = 0
+
+    def pin(self, block_id: int, data: bytes) -> bool:
+        with self._lock:
+            if block_id in self._data:
+                return True
+            if self._used + len(data) > self._capacity:
+                _M.incr("cache_pin_rejected")
+                return False
+            self._data[block_id] = data
+            self._used += len(data)
+            _M.incr("blocks_cached")
+            return True
+
+    def unpin(self, block_id: int) -> None:
+        with self._lock:
+            data = self._data.pop(block_id, None)
+            if data is not None:
+                self._used -= len(data)
+                _M.incr("blocks_uncached")
+
+    def get(self, block_id: int, offset: int = 0,
+            length: int = -1) -> bytes | None:
+        with self._lock:
+            data = self._data.get(block_id)
+        if data is None:
+            return None
+        _M.incr("cache_hits")
+        end = len(data) if length < 0 else min(offset + length, len(data))
+        return data[offset:end]
+
+    def ids(self) -> list[int]:
+        with self._lock:
+            return sorted(self._data)
+
+    def used(self) -> int:
+        with self._lock:
+            return self._used
+
+
 class DataNode:
     def __init__(self, config: DataNodeConfig, namenode_addr,
                  dn_id: str | None = None):
@@ -84,6 +135,7 @@ class DataNode:
         self._write_sem = threading.Semaphore(red.max_concurrent_writes)
         self._read_sem = threading.Semaphore(red.max_concurrent_reads)
         self._direct_sem = threading.Semaphore(red.max_concurrent_direct)
+        self.cache = PinnedCache(config.cache_capacity)
         self.dn_id = dn_id or f"dn-{uuid.uuid4().hex[:8]}"
         from hdrf_tpu.proto.rpc import normalize_addrs
         self._nns = [RpcClient(a) for a in normalize_addrs(namenode_addr)]
@@ -211,6 +263,10 @@ class DataNode:
         best-effort — the periodic full report reconciles anything missed.
         Carries the replica's gen stamp so the NN can fence a superseded
         pipeline's late finalize."""
+        # a (re)finalized replica invalidates any pinned copy: append's
+        # copy-on-append rewrites the same block id, and serving the stale
+        # pinned bytes would lose the appended region
+        self.cache.unpin(block_id)
         self._ibr_queue.append((block_id, length, gen_stamp))
         self._ibr_event.set()
 
@@ -375,6 +431,8 @@ class DataNode:
             "logical_bytes": sum(m[2] for m in self.replicas.block_report()),
             "physical_bytes": (self.replicas.physical_bytes()
                                + self.containers.physical_bytes()),
+            "cached_blocks": self.cache.ids(),
+            "cache_used": self.cache.used(),
             "index": self.index.stats(),
         }
 
@@ -389,6 +447,13 @@ class DataNode:
             self._ec_reconstruct(cmd)
         elif cmd["cmd"] == "recover_block":
             self._recover_block(cmd)
+        elif cmd["cmd"] == "cache":
+            for bid in cmd["block_ids"]:
+                if self.replicas.get_meta(bid) is not None:
+                    self.cache.pin(bid, self._sender.read_logical(bid))
+        elif cmd["cmd"] == "uncache":
+            for bid in cmd["block_ids"]:
+                self.cache.unpin(bid)
 
     def _peer_call(self, addr, op: str, **fields) -> dict:
         """One-shot framed request to a peer DN's xceiver (recovery ops)."""
@@ -476,6 +541,7 @@ class DataNode:
         _M.incr("block_recovery_failures")
 
     def _invalidate(self, block_id: int) -> None:
+        self.cache.unpin(block_id)
         meta = self.replicas.get_meta(block_id)
         if meta is None:
             return

@@ -110,6 +110,7 @@ class DatanodeInfo:
     sc_path: str | None = None  # short-circuit unix socket (co-located reads)
     rack: str = "/default-rack"
     storage_type: str = "DISK"  # StorageType analog (DISK/SSD/ARCHIVE/...)
+    cached: set[int] = field(default_factory=set)  # pinned block ids
 
 
 class LeaseManager:
@@ -222,6 +223,12 @@ class NameNode:
         self._snapshottable: set[str] = set()
         self._snapshots: dict[str, dict[str, dict]] = {}  # dir -> name -> tree
         self._quotas: dict[str, tuple[int, int]] = {}  # dir -> (ns, space)
+        # Centralized cache management (CacheManager.java:103 analog):
+        # pools bound directives; directives pin paths' blocks in DN RAM.
+        self._cache_pools: dict[str, dict] = {}   # name -> {owner, limit}
+        self._cache_dirs: dict[int, dict] = {}    # id -> {path, pool}
+        self._next_cache_id = 1
+        self._pending_cache: dict[tuple[int, str], float] = {}
         # Cached usage per quota root: [entries, bytes]; None = recompute on
         # next check (the reference maintains counts on the quota INode for
         # the same reason: O(subtree) walks per create don't scale).
@@ -349,6 +356,10 @@ class NameNode:
             "snapshottable": sorted(self._snapshottable),
             "snapshots": self._snapshots,
             "quotas": {p: list(q) for p, q in self._quotas.items()},
+            "cache_pools": self._cache_pools,
+            "cache_dirs": {i: [d["path"], d["pool"]]
+                           for i, d in self._cache_dirs.items()},
+            "next_cache_id": self._next_cache_id,
             "dtokens": self._dtokens.snapshot(),
         }
 
@@ -383,6 +394,11 @@ class NameNode:
                         for p, q in snap.get("quotas", {}).items()}
         self._next_block_id = snap["next_block_id"]
         self._gen_stamp = snap["gen_stamp"]
+        self._cache_pools = {k: dict(v) for k, v in
+                             snap.get("cache_pools", {}).items()}
+        self._cache_dirs = {i: {"path": v[0], "pool": v[1]}
+                            for i, v in snap.get("cache_dirs", {}).items()}
+        self._next_cache_id = snap.get("next_cache_id", 1)
         if "dtokens" in snap:
             self._dtokens.restore(snap["dtokens"])
 
@@ -506,7 +522,7 @@ class NameNode:
             if rec[2] >= 0:
                 node.mtime = rec[2]
         elif op == "concat":
-            _, dst, srcs = rec
+            _, dst, srcs, *_rest = rec
             dnode = self._file(dst)
             for sp in srcs:
                 snode = self._file(sp)
@@ -524,14 +540,25 @@ class NameNode:
                 parent, name = self._parent_of(sp)
                 parent.pop(name, None)
                 self._leases.drop(sp)
-            dnode.mtime = time.time()
+            dnode.mtime = rec[3] if len(rec) > 3 else 0.0
         elif op == "symlink":
             _, link, target, *rest = rec
             parent, name = self._parent_of(link, create=True,
                                            user=rest[0] if rest else None)
-            parent[name] = SymNode(target, Attrs(
-                rest[0] if rest else self._superuser,
-                "supergroup", 0o777))
+            parent[name] = SymNode(target, perm.inherit_attrs(
+                self._dir_attrs(parent), rest[0] if rest
+                else self._superuser, None, is_dir=False, umode=0o777))
+        elif op == "cachepool":
+            self._cache_pools[rec[1]] = {"owner": rec[2], "limit": rec[3]}
+        elif op == "rmcachepool":
+            self._cache_pools.pop(rec[1], None)
+            self._cache_dirs = {i: d for i, d in self._cache_dirs.items()
+                                if d["pool"] != rec[1]}
+        elif op == "cachedir":
+            self._cache_dirs[rec[1]] = {"path": rec[2], "pool": rec[3]}
+            self._next_cache_id = max(self._next_cache_id, rec[1] + 1)
+        elif op == "rmcachedir":
+            self._cache_dirs.pop(rec[1], None)
         elif op == "setperm":
             self._node_attrs(self._resolve(rec[1])).mode = rec[2]
         elif op == "setowner":
@@ -742,12 +769,17 @@ class NameNode:
     def _link_redirect(target: str, at: list[str], rest: list[str]):
         """Raise SymlinkRedirect for a link hit at path prefix ``at`` with
         remaining components ``rest``.  Relative targets resolve against
-        the LINK'S PARENT directory (POSIX), not the root."""
+        the LINK'S PARENT directory (POSIX), not the root.  The message
+        carries "original\nresolved" so a client retrying a MULTI-path op
+        (rename src/dst, concat srcs) can tell which argument redirected."""
         tgt = target.rstrip("/")
         if not tgt.startswith("/"):
             tgt = "/" + "/".join(at[:-1] + [tgt]) if len(at) > 1 \
                 else "/" + tgt
-        raise SymlinkRedirect(tgt + ("/" + "/".join(rest) if rest else ""))
+        orig = "/" + "/".join(at + rest)
+        raise SymlinkRedirect(
+            orig + "\n"
+            + tgt + ("/" + "/".join(rest) if rest else ""))
 
     def _peek_parent(self, path: str) -> tuple[dict | None, str]:
         """Non-mutating walk to ``path``'s parent: raises if a component is a
@@ -810,6 +842,16 @@ class NameNode:
         elif op in ("setperm", "setowner", "setacl", "setxattr", "rmxattr",
                     "setpolicy"):
             self._resolve(rec[1])
+        elif op == "cachepool":
+            if rec[1] in self._cache_pools:
+                raise FileExistsError(f"cache pool {rec[1]} exists")
+        elif op == "cachedir":
+            if rec[3] not in self._cache_pools:
+                raise FileNotFoundError(f"no cache pool {rec[3]}")
+            self._resolve(rec[2])
+        elif op == "rmcachedir":
+            if rec[1] not in self._cache_dirs:
+                raise FileNotFoundError(f"no cache directive {rec[1]}")
         elif op in ("setrepl", "settimes"):
             self._file(rec[1])
         elif op == "concat":
@@ -1463,6 +1505,116 @@ class NameNode:
         return {"name": name, "type": "dir", "children": len(node),
                 "owner": a.owner, "group": a.group, "mode": a.mode}
 
+    # ------------------------------------------------------ cache directives
+
+    def rpc_add_cache_pool(self, name: str, limit: int = -1) -> bool:
+        """cacheadmin -addPool (CacheManager.java:103 analog)."""
+        with self._lock:
+            self._check_access("/", super_only=True)
+            self._log(["cachepool", name,
+                       perm.caller()[0] or self._superuser, limit])
+            _M.incr("cache_pools_added")
+            return True
+
+    def rpc_remove_cache_pool(self, name: str) -> bool:
+        with self._lock:
+            self._check_access("/", super_only=True)
+            if name not in self._cache_pools:
+                return False
+            self._log(["rmcachepool", name])
+            return True
+
+    def rpc_list_cache_pools(self) -> dict:
+        with self._lock:
+            return {n: dict(p) for n, p in self._cache_pools.items()}
+
+    def rpc_add_cache_directive(self, path: str, pool: str) -> int:
+        """cacheadmin -addDirective: pin ``path``'s blocks (a file, or every
+        file under a directory) in DN memory; the cache monitor drives
+        DNA_CACHE commands until the DNs report the blocks pinned."""
+        with self._lock:
+            self._check_access(path, want=perm.READ)
+            did = self._next_cache_id
+            self._log(["cachedir", did, path, pool])
+            _M.incr("cache_directives_added")
+            return did
+
+    def rpc_remove_cache_directive(self, directive_id: int) -> bool:
+        with self._lock:
+            d = self._cache_dirs.get(directive_id)
+            if d is not None:
+                # the directive path's owner (or the superuser) controls it
+                self._check_access(d["path"], owner_only=True)
+            self._log(["rmcachedir", directive_id])
+            return True
+
+    def rpc_list_cache_directives(self) -> list[dict]:
+        with self._lock:
+            out = []
+            for did, d in sorted(self._cache_dirs.items()):
+                bids = self._directive_blocks(d["path"])
+                cached = sum(1 for b in bids
+                             if any(b in dn.cached
+                                    for dn in self._datanodes.values()))
+                out.append({"id": did, "path": d["path"], "pool": d["pool"],
+                            "blocks": len(bids), "blocks_cached": cached})
+            return out
+
+    def _directive_blocks(self, path: str) -> set[int]:
+        try:
+            node = self._resolve(path)
+        except (FileNotFoundError, NotADirectoryError, SymlinkRedirect):
+            return set()
+        out: set[int] = set()
+        files = [node] if isinstance(node, FileNode) \
+            else list(self._iter_files(node))
+        for fn in files:
+            if fn.complete and not fn.ec:
+                out.update(fn.blocks)
+        return out
+
+    CACHE_RETRY_S = 10.0
+
+    def _check_cache(self) -> None:
+        """Cache monitor (CacheReplicationMonitor analog): command one
+        holder of each directive-covered block to pin it; uncache pinned
+        blocks no directive covers anymore."""
+        with self._lock:
+            wanted: set[int] = set()
+            for d in self._cache_dirs.values():
+                wanted |= self._directive_blocks(d["path"])
+            now = time.monotonic()
+            # expire dead bookkeeping: entries for satisfied/removed
+            # directives or past their retry deadline (unbounded growth
+            # otherwise), and rotate holders so one full cache doesn't pin
+            # a directive unsatisfied forever
+            self._pending_cache = {k: v for k, v in
+                                   self._pending_cache.items()
+                                   if v > now and k[0] in wanted}
+            cached_anywhere: set[int] = set()
+            for dn in self._datanodes.values():
+                cached_anywhere |= dn.cached
+            for bid in wanted - cached_anywhere:
+                info = self._blocks.get(bid)
+                if info is None:
+                    continue
+                holders = sorted(d for d in info.locations
+                                 if d in self._datanodes)
+                target = next((d for d in holders
+                               if (bid, d) not in self._pending_cache),
+                              None)
+                if target is None:
+                    continue  # every holder tried recently; retry later
+                self._pending_cache[(bid, target)] = now + self.CACHE_RETRY_S
+                self._datanodes[target].commands.append(
+                    {"cmd": "cache", "block_ids": [bid]})
+                _M.incr("cache_commands_sent")
+            for dn in self._datanodes.values():
+                extra = dn.cached - wanted
+                if extra:
+                    dn.commands.append({"cmd": "uncache",
+                                        "block_ids": sorted(extra)})
+
     # ---------------------- storage policies / replication / times / concat
 
     def rpc_set_storage_policy(self, path: str, policy: str) -> bool:
@@ -1520,7 +1672,7 @@ class NameNode:
             for sp in srcs:
                 self._check_access(sp, want=perm.WRITE,
                                    parent_want=perm.WRITE)
-            self._log(["concat", dst, list(srcs)])
+            self._log(["concat", dst, list(srcs), time.time()])
             _M.incr("concat")
             return True
 
@@ -1878,6 +2030,8 @@ class NameNode:
                 return {"reregister": True, "commands": []}
             dn.last_heartbeat = time.monotonic()
             dn.stats = stats or {}
+            if "cached_blocks" in dn.stats:
+                dn.cached = set(dn.stats["cached_blocks"])
             keys = None
             if self._tokens is not None:
                 self._tokens.maybe_roll()
@@ -2561,6 +2715,7 @@ class NameNode:
                 self._check_dead_nodes()
                 self._check_replication()
                 self._settle_moves()
+                self._check_cache()
                 self._recover_leases()
                 with self._lock:
                     self._dtokens.purge_expired()

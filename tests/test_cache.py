@@ -1,0 +1,168 @@
+"""Centralized cache management (CacheManager.java:103 + the DN-side
+FsDatasetCache.java:67 pinned-memory path): pools, directives, the cache
+monitor driving DNA_CACHE/UNCACHE, and reads served from pinned memory."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from hdrf_tpu.testing.minicluster import MiniCluster
+
+RNG = np.random.default_rng(41)
+
+
+def _bytes(n):
+    return RNG.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_datanodes=2, replication=1, block_size=1 << 20) as mc:
+        yield mc
+
+
+def _wait_cached(c, did, nblocks, timeout=12.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        (d,) = [x for x in c.list_cache_directives() if x["id"] == did]
+        if d["blocks_cached"] >= nblocks:
+            return d
+        time.sleep(0.3)
+    pytest.fail("blocks never reported cached")
+
+
+class TestCacheDirectives:
+    def test_pool_and_directive_lifecycle(self, cluster):
+        with cluster.client("p") as c:
+            assert c.add_cache_pool("gold")
+            assert "gold" in c.list_cache_pools()
+            c.write("/cached/f", _bytes(300_000))
+            did = c.add_cache_directive("/cached/f", pool="gold")
+            d = _wait_cached(c, did, 1)
+            assert d["blocks"] == 1 and d["path"] == "/cached/f"
+            assert c.remove_cache_directive(did)
+            assert all(x["id"] != did for x in c.list_cache_directives())
+
+    def test_cached_read_skips_disk(self, cluster):
+        """The strong assertion: after caching, delete the replica's
+        on-disk data file — the read STILL succeeds (served from pinned
+        memory), proving the disk was never touched."""
+        with cluster.client("s") as c:
+            data = _bytes(500_000)
+            c.write("/cached/skip", data, scheme="direct")
+            c.add_cache_pool("hot") if "hot" not in c.list_cache_pools() \
+                else None
+            did = c.add_cache_directive("/cached/skip", pool="hot")
+            _wait_cached(c, did, 1)
+            # find the DN holding the pinned block and vandalize its disk
+            loc = c._call("get_block_locations", path="/cached/skip")
+            bid = loc["blocks"][0]["block_id"]
+            dn = next(d for d in cluster.datanodes
+                      if d is not None and bid in d.cache.ids())
+            import os
+
+            os.unlink(dn.replicas.data_path(bid))
+            assert c.read("/cached/skip") == data  # RAM, not disk
+            from hdrf_tpu.utils import metrics
+
+            assert metrics.registry("datanode").snapshot()[
+                "counters"].get("cache_hits", 0) > 0
+
+    def test_uncache_on_directive_removal(self, cluster):
+        with cluster.client("u") as c:
+            c.write("/cached/u", _bytes(200_000))
+            if "hot" not in c.list_cache_pools():
+                c.add_cache_pool("hot")
+            did = c.add_cache_directive("/cached/u", pool="hot")
+            _wait_cached(c, did, 1)
+            loc = c._call("get_block_locations", path="/cached/u")
+            bid = loc["blocks"][0]["block_id"]
+            c.remove_cache_directive(did)
+            deadline = time.time() + 12
+            while time.time() < deadline:
+                if not any(d is not None and bid in d.cache.ids()
+                           for d in cluster.datanodes):
+                    break
+                time.sleep(0.3)
+            else:
+                pytest.fail("block never uncached after directive removal")
+
+    def test_directive_on_directory_caches_all_files(self, cluster):
+        with cluster.client("d") as c:
+            if "hot" not in c.list_cache_pools():
+                c.add_cache_pool("hot")
+            for i in range(3):
+                c.write(f"/cdir/f{i}", _bytes(100_000))
+            did = c.add_cache_directive("/cdir", pool="hot")
+            d = _wait_cached(c, did, 3)
+            assert d["blocks"] == 3
+
+    def test_directives_survive_restart(self, tmp_path):
+        from hdrf_tpu.config import NameNodeConfig
+        from hdrf_tpu.server.namenode import NameNode
+
+        nn = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "nn")))
+        nn.rpc_add_cache_pool("p1")
+        nn.rpc_mkdir("/x")
+        did = nn.rpc_add_cache_directive("/x", pool="p1")
+        nn._editlog.close()
+        nn2 = NameNode(NameNodeConfig(meta_dir=str(tmp_path / "nn")))
+        assert "p1" in nn2.rpc_list_cache_pools()
+        assert any(d["id"] == did for d in nn2.rpc_list_cache_directives())
+        nn2._editlog.close()
+
+    def test_pool_required(self, cluster):
+        from hdrf_tpu.proto.rpc import RpcError
+
+        with cluster.client("e") as c:
+            c.write("/cached/np", b"x" * 100)
+            with pytest.raises(RpcError):
+                c.add_cache_directive("/cached/np", pool="nosuchpool")
+
+
+class TestReviewHoles:
+    def test_append_invalidates_pinned_block(self, cluster):
+        """Copy-on-append rewrites a pinned block id: the stale pinned
+        bytes must not serve the post-append read."""
+        with cluster.client("ap") as c:
+            if "hot" not in c.list_cache_pools():
+                c.add_cache_pool("hot")
+            data = _bytes(100_000)
+            c.write("/cached/ap", data, scheme="direct")
+            did = c.add_cache_directive("/cached/ap", pool="hot")
+            _wait_cached(c, did, 1)
+            c.append("/cached/ap", b"TAIL" * 100)
+            assert c.read("/cached/ap") == data + b"TAIL" * 100
+            c.remove_cache_directive(did)
+
+    def test_rename_through_symlink(self, cluster):
+        with cluster.client("rn") as c:
+            c.mkdir("/rtarget")
+            c.create_symlink("/rlink", "/rtarget")
+            c.write("/rtarget/x", b"move-me")
+            c.rename("/rlink/x", "/rlink/y")
+            assert c.read("/rtarget/y") == b"move-me"
+
+    def test_remove_directive_permission(self, cluster):
+        from hdrf_tpu.proto.rpc import RpcError
+        from hdrf_tpu.client.filesystem import HdrfClient
+
+        with cluster.client("own") as c:
+            if "hot" not in c.list_cache_pools():
+                c.add_cache_pool("hot")
+            c.mkdir("/home2")
+            c.chmod("/home2", 0o777)
+        al = HdrfClient(cluster.namenode.addr, user="alice")
+        mal = HdrfClient(cluster.namenode.addr, user="mallory")
+        try:
+            al.write("/home2/f", b"mine")
+            did = al.add_cache_directive("/home2/f", pool="hot")
+            with pytest.raises(RpcError):
+                mal.remove_cache_directive(did)
+            assert al.remove_cache_directive(did)
+        finally:
+            al.close()
+            mal.close()
