@@ -73,6 +73,12 @@ class ReductionConfig:
     # re-replication path covers post-crash chunk loss.  The index WAL is
     # always fsync'd (metadata integrity is not replication-recoverable).
     fsync_containers: bool = False
+    # Co-located reduction worker (host, port): when set, the DN streams
+    # block bytes to this separate worker PROCESS for CDC+SHA (and LZ4
+    # container seals) instead of computing in-process — the north-star
+    # deployment shape (BASELINE.json; bytes land in the worker's HBM as
+    # they stream).  None = in-process compute via ``backend``.
+    worker_addr: list | None = None
     cdc: CdcConfig = field(default_factory=CdcConfig)
 
 

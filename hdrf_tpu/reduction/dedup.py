@@ -196,9 +196,18 @@ class DedupScheme(ReductionScheme):
         assert ctx.index is not None and ctx.containers is not None
         tr = tracing.current_context()
         with tracing.tracer("dedup").span("reduce", parent=tr) as sp:
-            buf = np.frombuffer(data, dtype=np.uint8)
-            cuts, digests = dispatch.chunk_and_fingerprint(
-                buf, ctx.config.cdc, ctx.backend)
+            cuts = digests = None
+            if ctx.worker is not None:
+                from hdrf_tpu.server.reduction_worker import WorkerError
+
+                try:
+                    cuts, digests = ctx.worker.reduce(data, ctx.config.cdc)
+                except WorkerError:
+                    _M.incr("worker_fallbacks")  # dead worker: compute here
+            if cuts is None:
+                buf = np.frombuffer(data, dtype=np.uint8)
+                cuts, digests = dispatch.chunk_and_fingerprint(
+                    buf, ctx.config.cdc, ctx.backend)
             n, new = dedup_commit(block_id, data, cuts, digests,
                                   ctx.index, ctx.containers)
             sp.annotate("chunks", n)
@@ -206,6 +215,18 @@ class DedupScheme(ReductionScheme):
             _M.incr("blocks_reduced")
             _M.incr("bytes_logical", len(data))
         return b""  # replica data file stays empty by design
+
+    def reduce_with(self, block_id: int, data: bytes, cuts, digests,
+                    ctx: ReductionContext) -> bytes:
+        """Commit with PRECOMPUTED device results — the streaming worker
+        path: the DN already forwarded the packet stream to the worker and
+        holds (cuts, digests)."""
+        assert ctx.index is not None and ctx.containers is not None
+        dedup_commit(block_id, data, cuts, digests, ctx.index,
+                     ctx.containers)
+        _M.incr("blocks_reduced")
+        _M.incr("bytes_logical", len(data))
+        return b""
 
     # ---------------------------------------------------------------- read
 

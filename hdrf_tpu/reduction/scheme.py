@@ -44,6 +44,9 @@ class ReductionContext:
     containers: "ContainerStore | None" = None
     index: "ChunkIndex | None" = None
     backend: str = "native"  # resolved execution backend for the hot ops
+    # Co-located reduction worker client (reduction_worker.WorkerClient):
+    # when set, schemes offload their hot ops to the worker process.
+    worker: object | None = None
 
 
 class ReductionScheme(ABC):
@@ -126,6 +129,13 @@ class CompressScheme(ReductionScheme):
     def reduce(self, block_id: int, data: bytes, ctx: ReductionContext) -> bytes:
         from hdrf_tpu.ops import dispatch
 
+        if ctx.worker is not None:
+            from hdrf_tpu.server.reduction_worker import WorkerError
+
+            try:
+                return ctx.worker.compress(self._codec, data)
+            except WorkerError:
+                pass  # dead worker: host codec below
         return dispatch.block_compress(self._codec, data, ctx.backend)
 
     def reconstruct(self, block_id: int, stored: bytes, logical_len: int,

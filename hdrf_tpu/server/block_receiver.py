@@ -143,37 +143,97 @@ class BlockReceiver:
     # ----------------------------------------------------------- reduced path
 
     def receive_reduced(self, sock: socket.socket, fields: dict) -> None:
-        """Buffer the whole block (bf1 analog), reduce once, mirror the
-        reduced form, then send the final ack."""
+        """Reduce-path ingest.  The admission slot is acquired BEFORE any
+        buffering (the reference gates at op dispatch, DataXceiver.java:
+        349-380 — gating after the buffer fills is the unbounded-memory
+        failure mode SURVEY §7(b) warns about): at most
+        ``max_concurrent_writes`` blocks are ever buffered.
+
+        With a co-located reduction worker configured, packets are
+        FORWARDED to the worker as they arrive (client -> DN -> worker ->
+        HBM is one pipeline; the worker stages bytes to device mid-stream)
+        and only (cuts, digests) come back; otherwise the block buffers
+        locally (bf1 analog) and reduces in-process."""
         dn = self._dn
         block_id, gen_stamp = fields["block_id"], fields["gen_stamp"]
         scheme_name = fields["scheme"]
         targets = fields.get("targets", [])
-        parts: list[bytes] = []
-        last_seqno = 0
-        for seqno, data, last in dt.iter_packets(sock):
-            parts.append(data)
-            last_seqno = seqno
-            if not last:
-                dt.send_ack(sock, seqno)  # flow control; durability is the last ack
-        data = b"".join(parts)
-        with _TR.span("reduce_block",
-                      parent=tuple(fields["_trace"]) if fields.get("_trace") else None) as sp:
-            sp.annotate("block_id", block_id)
-            sp.annotate("scheme", scheme_name)
-            with dn.write_slot():  # admission control (DataXceiver.java:349-380)
-                status = self._store_and_mirror(block_id, gen_stamp, scheme_name,
-                                                data, targets)
-        dt.send_ack(sock, last_seqno, status)
+        scheme = dn.scheme(scheme_name)
+        with dn.write_slot():  # admission BEFORE buffering
+            parts: list[bytes] = []
+            last_seqno = [0]
+            packets = dt.iter_packets(sock)
+
+            def stream():
+                for seqno, data, last in packets:
+                    last_seqno[0] = seqno
+                    # ack (flow control) and buffer BEFORE yielding: a
+                    # consumer abandoning the generator mid-yield (worker
+                    # death) must lose neither the ack nor the bytes
+                    if not last:
+                        dt.send_ack(sock, seqno)
+                    if data:
+                        parts.append(data)
+                        yield data
+
+            precomputed = None
+            worker_down = False
+            use_worker = (dn.reduction_ctx.worker is not None
+                          and getattr(scheme, "container_codec", None)
+                          is not None)
+            if use_worker:
+                from hdrf_tpu.server.reduction_worker import WorkerError
+
+                try:
+                    precomputed = dn.reduction_ctx.worker.reduce_stream(
+                        stream(), dn.reduction_ctx.config.cdc)
+                    _M.incr("worker_reduces")
+                except WorkerError:
+                    # WORKER failed (client-stream errors propagate as
+                    # their own types and abort the write as before):
+                    # drain the remaining packets and compute in-process
+                    _M.incr("worker_fallbacks")
+                    worker_down = True
+                    for _ in stream():
+                        pass
+            else:
+                for _ in stream():
+                    pass
+            data = b"".join(parts)
+            if worker_down:
+                # compute here WITHOUT re-trying the dead worker (the
+                # scheme would otherwise reconnect per block while the
+                # admission slot is held)
+                import numpy as _np
+
+                from hdrf_tpu.ops import dispatch as _dispatch
+
+                precomputed = _dispatch.chunk_and_fingerprint(
+                    _np.frombuffer(data, dtype=_np.uint8),
+                    dn.reduction_ctx.config.cdc, dn.reduction_ctx.backend)
+            with _TR.span("reduce_block",
+                          parent=tuple(fields["_trace"])
+                          if fields.get("_trace") else None) as sp:
+                sp.annotate("block_id", block_id)
+                sp.annotate("scheme", scheme_name)
+                status = self._store_and_mirror(
+                    block_id, gen_stamp, scheme_name, data, targets,
+                    precomputed=precomputed)
+        dt.send_ack(sock, last_seqno[0], status)
         _M.incr("blocks_received_reduced")
 
     def _store_and_mirror(self, block_id: int, gen_stamp: int, scheme_name: str,
-                          data: bytes, targets: list) -> int:
+                          data: bytes, targets: list,
+                          precomputed=None) -> int:
         dn = self._dn
         scheme = dn.scheme(scheme_name)
         crcs = _checksums(data, dn.checksum_chunk)
         with metrics.registry("datanode").time("reduce_us"):
-            stored = scheme.reduce(block_id, data, dn.reduction_ctx)
+            if precomputed is not None:
+                stored = scheme.reduce_with(block_id, data, *precomputed,
+                                            dn.reduction_ctx)
+            else:
+                stored = scheme.reduce(block_id, data, dn.reduction_ctx)
         writer = dn.replicas.create_rbw(block_id, gen_stamp)
         try:
             if stored:

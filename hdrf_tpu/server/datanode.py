@@ -117,12 +117,33 @@ class DataNode:
             self.replicas = ReplicaStore(
                 os.path.join(config.data_dir, "replicas"))
         backend = ops_dispatch.resolve_backend(red.backend)
-        # On the TPU backend the container seal's entropy stage (the
-        # reference's rollover LZ4, DataDeduplicator.java:770-781) runs its
-        # match discovery on device; output stays stock LZ4 block format.
-        seal_fn = (
-            (lambda data: ops_dispatch.block_compress("lz4", data, "tpu"))
-            if backend == "tpu" and red.container_codec == "lz4" else None)
+        # Seal entropy stage (the reference's rollover LZ4,
+        # DataDeduplicator.java:770-781), most-capable-first: the
+        # co-located worker process (device-owning; the DN host stays
+        # device-free, falling back to the host codec if it dies), else
+        # the in-process TPU path, else the host codec default.
+        self._worker = None
+        seal_fn = None
+        if red.worker_addr:
+            from hdrf_tpu.server.reduction_worker import (WorkerClient,
+                                                          WorkerError)
+
+            self._worker = WorkerClient(tuple(red.worker_addr))
+
+            def _worker_seal(data: bytes) -> bytes:
+                try:
+                    return self._worker.compress("lz4", data)
+                except WorkerError:
+                    _M.incr("worker_fallbacks")
+                    from hdrf_tpu.utils import codec as codecs
+
+                    return codecs.compress("lz4", data)
+
+            if red.container_codec == "lz4":
+                seal_fn = _worker_seal
+        elif backend == "tpu" and red.container_codec == "lz4":
+            seal_fn = (lambda data:
+                       ops_dispatch.block_compress("lz4", data, "tpu"))
         self.containers = ContainerStore(
             os.path.join(config.data_dir, "containers"),
             container_size=red.container_size, codec=red.container_codec,
@@ -130,7 +151,7 @@ class DataNode:
         self.index = ChunkIndex(os.path.join(config.data_dir, "index"))
         self.reduction_ctx = ReductionContext(
             config=red, containers=self.containers, index=self.index,
-            backend=backend)
+            backend=backend, worker=self._worker)
         # Admission control: bounded slots instead of ticket queues.
         self._write_sem = threading.Semaphore(red.max_concurrent_writes)
         self._read_sem = threading.Semaphore(red.max_concurrent_reads)
@@ -212,6 +233,8 @@ class DataNode:
             t.join(timeout=5)
         self.containers.flush_open(on_seal=self.index.seal_container)
         self.index.close()
+        if self._worker is not None:
+            self._worker.close()
         for nn in self._nns:
             nn.close()
 

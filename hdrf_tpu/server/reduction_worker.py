@@ -1,0 +1,377 @@
+"""Co-located TPU reduction worker: a separate process owning the device.
+
+The north-star deployment (BASELINE.json; SURVEY.md §2.4 "bulk transport"):
+*"BlockReceiver streams 128 MB block packets over gRPC to a co-located TPU
+worker; bytes land in HBM."*  This daemon is that worker — the TPU-side
+equivalent of the reference's in-process JNI boundary (DataXceiver ->
+libnayuki/codecs), lifted into its own process so the DataNode host stays
+device-free:
+
+- **Streaming ingest**: the DataNode forwards block packets AS RECEIVED
+  over the owned framed protocol (same packet framing as DN<->DN transfer);
+  the worker stages them to HBM in stride-sized device uploads while later
+  packets are still arriving, then assembles the resident block
+  device-side — bytes land in HBM before the stream even finishes.
+- **Compute**: CDC candidate scan + bucketed SHA-256 via
+  ops.resident.ResidentReducer on the resident image; LZ4 match discovery
+  via ops.lz4_tpu.  Only cuts/digests/compressed bytes return to the DN —
+  O(chunks), not O(block).
+- **Completion**: the DN's admission slot is held across the round trip
+  and released when the response lands (the DDRunner completion-callback
+  role, DDRunner.java:37-53, with real backpressure instead of ticket
+  arithmetic).
+
+Run standalone: ``python -m hdrf_tpu.server.reduction_worker --port 0``.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Any
+
+import numpy as np
+
+from hdrf_tpu.config import CdcConfig
+from hdrf_tpu.proto import datatransfer as dt
+from hdrf_tpu.proto.rpc import recv_frame, send_frame
+from hdrf_tpu.utils import metrics
+
+_M = metrics.registry("reduction_worker")
+
+# Device upload stride for streaming ingest: big enough to amortize the
+# per-transfer cost, small enough that HBM staging overlaps the tail of
+# the network stream.
+_STRIDE = 4 << 20
+
+
+class ReductionWorker:
+    """The worker daemon.  Thread-per-connection like the DN xceiver; the
+    device work itself is serialized by JAX's stream, so concurrent jobs
+    interleave at dispatch granularity."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 backend: str = "auto"):
+        from hdrf_tpu.ops import dispatch as ops_dispatch
+
+        self.backend = ops_dispatch.resolve_backend(backend)
+        self._reducers: dict[tuple, Any] = {}
+        self._lz4 = None
+        self._stats_lock = threading.Lock()
+        self._stats = {"blocks_reduced": 0, "bytes_reduced": 0,
+                       "compress_jobs": 0}
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        req = recv_frame(sock)
+                        outer._dispatch(sock, req)
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._server.server_address
+
+    def start(self) -> "ReductionWorker":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="reduction-worker", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch(self, sock: socket.socket, req: dict) -> None:
+        op = req.get("op")
+        try:
+            if op == "reduce":
+                self._op_reduce(sock, req)
+            elif op == "compress":
+                self._op_compress(sock, req)
+            elif op == "ping":
+                send_frame(sock, {"ok": True, "backend": self.backend})
+            elif op == "stats":
+                with self._stats_lock:
+                    send_frame(sock, dict(self._stats))
+            else:
+                send_frame(sock, {"error": "NoSuchOp", "message": str(op)})
+        except (ConnectionError, OSError):
+            raise
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            _M.incr("op_errors")
+            send_frame(sock, {"error": type(e).__name__, "message": str(e)})
+
+    def _reducer(self, cdc: CdcConfig):
+        key = (cdc.mask_bits, cdc.min_chunk, cdc.max_chunk)
+        r = self._reducers.get(key)
+        if r is None:
+            from hdrf_tpu.ops.resident import ResidentReducer
+
+            r = self._reducers[key] = ResidentReducer(cdc)
+        return r
+
+    def _op_reduce(self, sock: socket.socket, req: dict) -> None:
+        """Packet stream -> (cuts, digests).  TPU backend: packets stage to
+        HBM in _STRIDE device uploads DURING the stream; the resident block
+        is assembled device-side."""
+        cdc = CdcConfig(mask_bits=req["mask_bits"],
+                        min_chunk=req["min_chunk"],
+                        max_chunk=req["max_chunk"])
+        if self.backend == "tpu":
+            cuts, digs = self._reduce_streaming_tpu(sock, cdc)
+        else:
+            from hdrf_tpu.ops import dispatch as ops_dispatch
+
+            data = dt.collect_packets(sock)
+            buf = np.frombuffer(data, dtype=np.uint8)
+            cuts, digs = ops_dispatch.chunk_and_fingerprint(
+                buf, cdc, self.backend)
+        with self._stats_lock:
+            self._stats["blocks_reduced"] += 1
+            self._stats["bytes_reduced"] += int(cuts[-1]) if len(cuts) else 0
+        send_frame(sock, {"cuts": np.asarray(cuts, np.int64).tobytes(),
+                          "digests": np.ascontiguousarray(digs).tobytes()})
+        _M.incr("blocks_reduced")
+
+    def _reduce_streaming_tpu(self, sock: socket.socket, cdc: CdcConfig):
+        import jax
+        import jax.numpy as jnp
+
+        parts: list = []        # resident device strides (uploads in flight)
+        pend: list[bytes] = []  # current stride accumulator
+        pend_n = 0
+        total = 0
+        for _seq, data, _last in dt.iter_packets(sock):
+            if data:
+                pend.append(data)
+                pend_n += len(data)
+                total += len(data)
+                if pend_n >= _STRIDE:
+                    blob = np.frombuffer(b"".join(pend), np.uint8)
+                    parts.append(jax.device_put(blob))  # async H2D: lands
+                    # in HBM while the next packets stream in
+                    pend, pend_n = [], 0
+        if pend:
+            parts.append(jax.device_put(
+                np.frombuffer(b"".join(pend), np.uint8)))
+        if not parts:
+            return np.empty(0, np.int64), np.empty((0, 32), np.uint8)
+        from hdrf_tpu.ops.resident import _PAD_GRID
+
+        pad = (-total) % _PAD_GRID
+        if pad:
+            parts.append(jnp.zeros(pad, jnp.uint8))
+        block = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        r = self._reducer(cdc)
+        job = r.submit(block, n=total)
+        r.start_sha(job)
+        return r.finish(job)
+
+    def _op_compress(self, sock: socket.socket, req: dict) -> None:
+        from hdrf_tpu.ops import dispatch as ops_dispatch
+
+        data = dt.collect_packets(sock)
+        out = ops_dispatch.block_compress(req.get("codec", "lz4"), data,
+                                          self.backend)
+        with self._stats_lock:
+            self._stats["compress_jobs"] += 1
+        send_frame(sock, {"data": bytes(out)})
+        _M.incr("compress_jobs")
+
+
+# ------------------------------------------------------------------ client
+
+
+class WorkerError(IOError):
+    """Worker-side failure (connect/protocol/compute).  DISTINCT from the
+    caller's own stream errors: a DN forwarding client packets must treat a
+    dead worker as 'fall back to in-process compute' but a dead CLIENT as a
+    failed write — conflating them would commit truncated blocks."""
+
+
+class WorkerClient:
+    """DN-side handle on the co-located worker.  One pooled connection per
+    concurrent job (connections are cheap on loopback; the pool bound comes
+    from the DN's admission slots holding across the round trip)."""
+
+    def __init__(self, addr, timeout: float = 600.0):
+        self._addr = (addr[0], int(addr[1]))
+        self._timeout = timeout
+        self._pool: list[socket.socket] = []
+        self._lock = threading.Lock()
+
+    def _conn(self) -> socket.socket:
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        try:
+            s = socket.create_connection(self._addr, timeout=self._timeout)
+        except OSError as e:
+            raise WorkerError(f"worker unreachable: {e}") from e
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _release(self, s: socket.socket) -> None:
+        with self._lock:
+            if len(self._pool) < 8:
+                self._pool.append(s)
+                return
+        s.close()
+
+    def _checked(self, resp: dict) -> dict:
+        if "error" in resp:
+            raise WorkerError(
+                f"worker: {resp['error']}: {resp['message']}")
+        return resp
+
+    def reduce_stream(self, packets, cdc: CdcConfig):
+        """Forward an iterator of byte packets; returns (cuts, digests).
+        This is the true streaming path: the DN calls it from inside its
+        packet-receive loop, so client->DN->worker->HBM is one pipeline.
+
+        Exception taxonomy: worker-side failures raise :class:`WorkerError`;
+        anything the ``packets`` iterator itself raises (the caller's OWN
+        stream — e.g. the DN's client connection dying) propagates
+        unchanged, so the caller can tell the two apart."""
+        s = self._conn()
+        try:
+            try:
+                send_frame(s, {"op": "reduce", "mask_bits": cdc.mask_bits,
+                               "min_chunk": cdc.min_chunk,
+                               "max_chunk": cdc.max_chunk})
+            except OSError as e:
+                raise WorkerError(f"worker send failed: {e}") from e
+            seq = 0
+            it = iter(packets)
+            while True:
+                try:
+                    data = next(it)  # caller errors propagate UNWRAPPED
+                except StopIteration:
+                    break
+                if not data:
+                    continue
+                try:
+                    dt.write_packet(s, seq, data)
+                except OSError as e:
+                    raise WorkerError(f"worker send failed: {e}") from e
+                seq += 1
+            try:
+                dt.write_packet(s, seq, b"", last=True)
+                resp = self._checked(recv_frame(s))
+            except (OSError, ConnectionError) as e:
+                raise WorkerError(f"worker failed: {e}") from e
+            cuts = np.frombuffer(resp["cuts"], np.int64)
+            digs = np.frombuffer(resp["digests"],
+                                 np.uint8).reshape(-1, 32)
+            self._release(s)
+            return cuts, digs
+        except BaseException:
+            s.close()
+            raise
+
+    def reduce(self, data: bytes, cdc: CdcConfig):
+        return self.reduce_stream([data], cdc)
+
+    def compress(self, codec: str, data: bytes) -> bytes:
+        s = self._conn()
+        try:
+            try:
+                send_frame(s, {"op": "compress", "codec": codec})
+                dt.stream_bytes(s, data, 1 << 20)
+                out = bytes(self._checked(recv_frame(s))["data"])
+            except (OSError, ConnectionError) as e:
+                raise WorkerError(f"worker failed: {e}") from e
+            self._release(s)
+            return out
+        except BaseException:
+            s.close()
+            raise
+
+    def ping(self) -> dict:
+        s = self._conn()
+        try:
+            send_frame(s, {"op": "ping"})
+            out = self._checked(recv_frame(s))
+            self._release(s)
+            return out
+        except BaseException:
+            s.close()
+            raise
+
+    def stats(self) -> dict:
+        s = self._conn()
+        try:
+            send_frame(s, {"op": "stats"})
+            out = self._checked(recv_frame(s))
+            self._release(s)
+            return out
+        except BaseException:
+            s.close()
+            raise
+
+    def close(self) -> None:
+        with self._lock:
+            for s in self._pool:
+                s.close()
+            self._pool.clear()
+
+
+def spawn_local_worker(backend: str = "auto"):
+    """Launch a worker as a real SEPARATE PROCESS (the co-located
+    deployment shape); returns (Popen, (host, port)).  The caller owns the
+    process (terminate() when done)."""
+    import re
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hdrf_tpu.server.reduction_worker",
+         "--port", "0", "--backend", backend],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline()
+    m = re.search(r"listening on ([\d.]+):(\d+)", line)
+    if not m:
+        proc.terminate()
+        raise RuntimeError(f"worker failed to start: {line!r}")
+    return proc, (m.group(1), int(m.group(2)))
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="hdrf-reduction-worker")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--backend", default="auto")
+    args = p.parse_args(argv)
+    w = ReductionWorker(args.host, args.port, backend=args.backend).start()
+    print(f"reduction worker ({w.backend}) listening on "
+          f"{w.addr[0]}:{w.addr[1]}", flush=True)
+    try:
+        while True:
+            import time
+
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        w.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

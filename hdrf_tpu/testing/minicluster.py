@@ -26,19 +26,26 @@ class MiniCluster:
                  container_size: int = 1 << 22, heartbeat_s: float = 0.2,
                  dead_node_s: float = 1.5, ha: bool = False,
                  journal_nodes: int = 0, secure: bool = False,
-                 storage_types: list[str] | None = None):
+                 storage_types: list[str] | None = None,
+                 tpu_worker: bool = False):
         """``journal_nodes`` > 0 boots that many JournalNodes and puts the
         edit log on the quorum (MiniQJMHACluster analog); each NN then gets
         its OWN meta_dir (only the shared-dir deployment shares one).
         ``secure`` turns on the whole security matrix: block tokens,
         delegation-token-authenticated RPCs, and encrypted data transfer.
         ``storage_types`` assigns each DN a StorageType (DISK/SSD/ARCHIVE)
-        for storage-policy tests."""
+        for storage-policy tests.  ``tpu_worker`` spawns ONE co-located
+        reduction-worker PROCESS shared by every DN (the north-star
+        out-of-process deployment; backend auto-resolves — native on the
+        CPU test mesh, device on a real chip)."""
         self.n_datanodes = n_datanodes
         self.ha = ha
         self.n_journal = journal_nodes
         self.secure = secure
         self.storage_types = storage_types or []
+        self.tpu_worker = tpu_worker
+        self._worker_proc = None
+        self._worker_addr = None
         self._own_dir = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="hdrf-mini-")
         self.nn_config = NameNodeConfig(
@@ -58,6 +65,10 @@ class MiniCluster:
     def start(self) -> "MiniCluster":
         import dataclasses
 
+        if self.tpu_worker:
+            from hdrf_tpu.server.reduction_worker import spawn_local_worker
+
+            self._worker_proc, self._worker_addr = spawn_local_worker()
         if self.n_journal:
             from hdrf_tpu.server.journal import JournalNode
 
@@ -110,6 +121,8 @@ class MiniCluster:
             block_report_interval_s=5.0)
         cfg.reduction.container_size = self._dn_kw["container_size"]
         cfg.reduction.backend = "native"  # deterministic in tests
+        if self._worker_addr is not None:
+            cfg.reduction.worker_addr = list(self._worker_addr)
         cfg.encrypt_data_transfer = self.secure
         if i < len(self.storage_types):
             cfg.storage_type = self.storage_types[i]
@@ -128,6 +141,10 @@ class MiniCluster:
                 jn.stop()
             except Exception:  # noqa: BLE001 — may already be stopped
                 pass
+        if self._worker_proc is not None:
+            self._worker_proc.terminate()
+            self._worker_proc.wait(timeout=5)
+            self._worker_proc = None
         if self._own_dir:
             shutil.rmtree(self.base_dir, ignore_errors=True)
 

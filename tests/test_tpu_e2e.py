@@ -78,3 +78,40 @@ def test_pallas_sha_nonmultiple_tile_rows_real_chip():
         for i in range(L):
             assert bytes(out[i]) == hashlib.sha256(
                 data[i].tobytes()).digest(), (L, i)
+
+
+@pytest.mark.skipif(not _tpu_attached(), reason="needs HDRF_TEST_TPU=1 + TPU")
+def test_worker_process_on_real_chip():
+    """The north-star deployment on real hardware: a separate worker
+    process owns the TPU; the DN streams block packets to it and bytes
+    land in HBM mid-stream (reduction_worker._reduce_streaming_tpu)."""
+    import numpy as np
+
+    from hdrf_tpu.config import CdcConfig
+    from hdrf_tpu.ops.dispatch import gear_mask
+    from hdrf_tpu.server.reduction_worker import (WorkerClient,
+                                                  spawn_local_worker)
+    from hdrf_tpu import native
+
+    proc, addr = spawn_local_worker(backend="auto")
+    try:
+        c = WorkerClient(addr)
+        assert c.ping()["backend"] == "tpu"
+        cdc = CdcConfig()
+        rng = np.random.default_rng(7)
+        data = rng.integers(0, 256, size=8 << 20, dtype=np.uint8).tobytes()
+        pkts = [data[i:i + 65536] for i in range(0, len(data), 65536)]
+        cuts, digs = c.reduce_stream(iter(pkts), cdc)
+        wc = native.cdc_chunk(np.frombuffer(data, np.uint8),
+                              gear_mask(cdc), cdc.min_chunk, cdc.max_chunk)
+        starts = np.concatenate([[0], wc[:-1]]).astype(np.uint64)
+        wd = native.sha256_batch(np.frombuffer(data, np.uint8), starts,
+                                 (wc - starts).astype(np.uint64))
+        np.testing.assert_array_equal(cuts, wc.astype(np.int64))
+        np.testing.assert_array_equal(digs, wd)
+        comp = c.compress("lz4", data[:1 << 20])
+        assert native.lz4_decompress(comp, 1 << 20) == data[:1 << 20]
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -1,0 +1,124 @@
+"""Co-located reduction worker: a SEPARATE process serving the DN's hot
+ops over the streaming protocol (the BASELINE.json north-star deployment:
+BlockReceiver streams block packets to the worker; bytes land in HBM).
+
+On the CPU test mesh the worker backend auto-resolves to native — the
+plumbing (process boundary, streaming ingest, completion flow, fallback)
+is identical; the real-chip variant runs in test_tpu_e2e.py."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hdrf_tpu.config import CdcConfig
+from hdrf_tpu.ops.dispatch import gear_mask
+from hdrf_tpu.server.reduction_worker import (ReductionWorker, WorkerClient,
+                                              spawn_local_worker)
+from hdrf_tpu.testing.minicluster import MiniCluster
+
+RNG = np.random.default_rng(51)
+
+
+def _bytes(n):
+    return RNG.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+class TestWorkerProtocol:
+    @pytest.fixture(scope="class")
+    def worker(self):
+        w = ReductionWorker(backend="native").start()
+        yield w
+        w.stop()
+
+    def test_reduce_matches_oracle(self, worker):
+        from hdrf_tpu import native
+
+        cdc = CdcConfig()
+        data = _bytes(300_000)
+        c = WorkerClient(worker.addr)
+        cuts, digs = c.reduce(data, cdc)
+        wc = native.cdc_chunk(np.frombuffer(data, np.uint8), gear_mask(cdc),
+                              cdc.min_chunk, cdc.max_chunk)
+        starts = np.concatenate([[0], wc[:-1]]).astype(np.uint64)
+        wd = native.sha256_batch(np.frombuffer(data, np.uint8), starts,
+                                 (wc - starts).astype(np.uint64))
+        np.testing.assert_array_equal(cuts, wc.astype(np.int64))
+        np.testing.assert_array_equal(digs, wd)
+        c.close()
+
+    def test_streaming_matches_whole(self, worker):
+        cdc = CdcConfig()
+        data = _bytes(500_000)
+        c = WorkerClient(worker.addr)
+        whole = c.reduce(data, cdc)
+        pkts = [data[i:i + 64 * 1024] for i in range(0, len(data), 64 * 1024)]
+        streamed = c.reduce_stream(iter(pkts), cdc)
+        np.testing.assert_array_equal(whole[0], streamed[0])
+        np.testing.assert_array_equal(whole[1], streamed[1])
+        c.close()
+
+    def test_compress_roundtrip(self, worker):
+        from hdrf_tpu import native
+
+        data = (b"the quick brown fox " * 5000)[:80_000]
+        c = WorkerClient(worker.addr)
+        comp = c.compress("lz4", data)
+        assert native.lz4_decompress(comp, len(data)) == data
+        c.close()
+
+    def test_ping_and_stats(self, worker):
+        c = WorkerClient(worker.addr)
+        assert c.ping()["backend"] == "native"
+        before = c.stats()["blocks_reduced"]
+        c.reduce(_bytes(10_000), CdcConfig())
+        assert c.stats()["blocks_reduced"] == before + 1
+        c.close()
+
+
+class TestWorkerProcess:
+    def test_spawn_real_process(self):
+        proc, addr = spawn_local_worker(backend="native")
+        try:
+            c = WorkerClient(addr)
+            assert c.ping()["ok"]
+            cuts, digs = c.reduce(_bytes(100_000), CdcConfig())
+            assert int(cuts[-1]) == 100_000 and digs.shape[1] == 32
+            c.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+
+class TestClusterWithWorker:
+    def test_out_of_process_reduction_e2e(self):
+        """The MiniCluster flag the VERDICT asked for: every dedup write
+        flows DN -> worker process; the worker's stats prove it served."""
+        with MiniCluster(n_datanodes=2, replication=2, block_size=1 << 20,
+                         tpu_worker=True) as mc:
+            wc = WorkerClient(mc._worker_addr)
+            assert wc.ping()["ok"]
+            data = _bytes(1_500_000) + _bytes(200_000) * 2
+            with mc.client("w") as c:
+                c.write("/w/f", data, scheme="dedup_lz4")
+                assert c.read("/w/f") == data
+                c.write("/w/g", data[:300_000], scheme="dedup_lz4")
+                assert c.read("/w/g") == data[:300_000]
+            st = wc.stats()
+            assert st["blocks_reduced"] >= 3  # every dedup block offloaded
+            wc.close()
+
+    def test_worker_death_falls_back_in_process(self):
+        """Kill the worker mid-cluster: writes keep succeeding via the
+        in-process fallback (availability over offload)."""
+        with MiniCluster(n_datanodes=1, replication=1, block_size=1 << 20,
+                         tpu_worker=True) as mc:
+            data = _bytes(400_000)
+            second = data[:100_000] + _bytes(50_000)
+            with mc.client("w") as c:
+                c.write("/f1", data, scheme="dedup_lz4")
+                mc._worker_proc.terminate()
+                mc._worker_proc.wait(timeout=5)
+                c.write("/f2", second, scheme="dedup_lz4")
+                assert c.read("/f2") == second
+                assert c.read("/f1") == data
