@@ -77,6 +77,23 @@ def fingerprints(data: bytes | np.ndarray, cuts: np.ndarray,
 
 
 _resident_cache: dict = {}
+_mesh_cache: list = []
+
+
+def _multichip_mesh():
+    """The flat ('data'=1, 'seq'=n) mesh over every attached device, built
+    once — the serving path's multi-chip form engages automatically when
+    more than one device is present."""
+    if not _mesh_cache:
+        import jax
+
+        from hdrf_tpu.parallel.sharded import make_mesh
+
+        devs = jax.devices()
+        _mesh_cache.append(make_mesh(n_data=1, n_seq=len(devs),
+                                     devices=devs)
+                           if len(devs) > 1 else None)
+    return _mesh_cache[0]
 
 
 def chunk_and_fingerprint(data: bytes | np.ndarray, cdc: CdcConfig,
@@ -86,9 +103,17 @@ def chunk_and_fingerprint(data: bytes | np.ndarray, cdc: CdcConfig,
     On the TPU backend this routes through ops.resident.ResidentReducer so
     the block crosses to HBM once and the gather/SHA read the resident image
     (the naive chunk_cuts+fingerprints composition re-uploads the block per
-    stage).  The native path is the CPU baseline pair of calls.
+    stage).  With MULTIPLE devices attached, the block instead runs the
+    sharded pipeline (parallel/sharded.reduce_sharded): seq-parallel
+    candidate scan with ICI halo exchange + chunk-parallel SHA lanes over
+    every chip.  The native path is the CPU baseline pair of calls.
     """
     if backend == "tpu":
+        mesh = _multichip_mesh()
+        if mesh is not None:
+            from hdrf_tpu.parallel.sharded import reduce_sharded
+
+            return reduce_sharded(data, cdc, mesh)
         from hdrf_tpu.ops.resident import ResidentReducer
 
         key = (cdc.mask_bits, cdc.min_chunk, cdc.max_chunk)

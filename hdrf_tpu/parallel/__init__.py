@@ -1,11 +1,12 @@
 """Multi-chip parallelism: mesh construction, sequence-parallel CDC scan with
-ICI halo exchange, data-parallel SHA lanes, and the combined sharded
-reduction step (see sharded.py)."""
+ICI halo exchange, data-parallel SHA lanes, the combined sharded reduction
+step, and the REAL variable-chunk sharded pipeline (reduce_sharded)."""
 
 from hdrf_tpu.parallel.sharded import (  # noqa: F401
     candidate_words_sharded,
     gear_candidates_sharded,
     make_mesh,
+    reduce_sharded,
     reduction_step,
     sha256_lanes_sharded,
 )

@@ -175,6 +175,103 @@ def reduction_step(mesh: Mesh, seg: int = 512):
     return jax.jit(fn)
 
 
+# --------------------------------------------------------------------------
+# The REAL variable-chunk pipeline, sharded (the serving path's multi-chip
+# form: seq-parallel candidate scan -> host cut select -> chunk-parallel
+# SHA over the actual CDC chunks, lanes spread across every device)
+# --------------------------------------------------------------------------
+
+_sha_fns: dict = {}
+
+
+def _sha_chunks_sharded(mesh: Mesh, bucket: int, pad_words: int):
+    """Variable-chunk SHA with lanes sharded over the FLATTENED mesh.  The
+    block arrives SEQ-SHARDED (the same resident shards the candidate scan
+    used — one H2D total); each device all-gathers the full byte image
+    over ICI, word-images it, and DMA/gathers + hashes its own lane
+    subset.  Chunk fingerprints are embarrassingly parallel once cuts are
+    known; the all_gather is the only collective."""
+    from hdrf_tpu.ops.resident import _bucket_sha, be_word_image
+
+    key = (mesh, bucket, pad_words)  # Mesh hashes by devices+axis names
+    fn = _sha_fns.get(key)
+    if fn is not None:
+        return fn
+    axes = tuple(mesh.axis_names)
+
+    def local(block_shard: jax.Array, ol: jax.Array) -> jax.Array:
+        full = jax.lax.all_gather(block_shard, "seq", tiled=True)
+        words = jnp.concatenate([be_word_image(full),
+                                 jnp.zeros(pad_words, jnp.uint32)])
+        return _bucket_sha(words, ol, bucket)
+
+    fn = jax.jit(_shard_map(
+        local, mesh=mesh,
+        in_specs=(P("seq"), P(None, axes)), out_specs=P(axes)))
+    _sha_fns[key] = fn
+    return fn
+
+
+def reduce_sharded(data: bytes | np.ndarray, cdc, mesh: Mesh):
+    """(cuts, digests) for ONE block with every stage on the mesh — the
+    multi-chip form of ops.dispatch.chunk_and_fingerprint, bit-identical
+    to the native oracle (asserted in tests/test_sharding.py and the
+    driver's dryrun):
+
+    1. all-position Gear candidate scan, byte axis sharded over 'seq' with
+       the ppermute halo exchange (ICI neighbor traffic);
+    2. host cut selection over the sparse candidates (O(chunks) control
+       flow — data-dependent, so host-side, same as single-device);
+    3. SHA-256 of the actual VARIABLE chunks, lanes sharded across every
+       device; the byte image reaches each chip via an ICI all_gather of
+       the SAME seq-sharded resident bytes stage 1 used — the block
+       crosses the host->device boundary exactly once.
+    """
+    from hdrf_tpu import native
+    from hdrf_tpu.ops.dispatch import gear_mask
+    from hdrf_tpu.ops.resident import _bucket_of
+
+    a = (np.frombuffer(data, dtype=np.uint8)
+         if not isinstance(data, np.ndarray) else data)
+    n = a.size
+    if n == 0:  # same contract as ResidentReducer's n==0 special case
+        return np.empty(0, dtype=np.uint64), np.empty((0, 32), np.uint8)
+    assert n < (1 << 31), "i32 lane offsets: shard blocks beyond 2 GiB"
+    mask = gear_mask(cdc)
+    n_seq = mesh.shape["seq"]
+    # one padded image serves BOTH stages: shard-size granularity for the
+    # scan (each seq shard % 256) and the word-image grid (% 512)
+    grid = 512 * n_seq
+    buf = np.zeros(n + ((-n) % grid), dtype=np.uint8)
+    buf[:n] = a
+    block_sh = jax.device_put(buf, NamedSharding(mesh, P("seq")))
+    words, _ = candidate_words_sharded(mesh)(
+        block_sh, jnp.uint32(mask & 0xFFFFFFFF))
+    wv = np.asarray(words)
+    (idx,) = np.nonzero(wv)
+    pos = gear._words_to_positions(idx.astype(np.uint32), wv[idx], n)
+    cuts = native.cdc_select(pos, n, cdc.min_chunk, cdc.max_chunk)
+    starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
+    lens = (cuts - starts).astype(np.int64)
+    nchunks = len(cuts)
+    ndev = int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
+    # one bucket sized for max_chunk: a stable jit key across blocks (the
+    # single-device path's finer bucketing is a padded-FLOPs optimization,
+    # not a correctness requirement)
+    bucket = _bucket_of((cdc.max_chunk + 9 + 63) // 64)
+    lane_grid = 128 * ndev
+    L = max(-(-nchunks // lane_grid) * lane_grid, lane_grid)
+    ol = np.zeros((2, L), dtype=np.int32)
+    ol[0, :nchunks] = starts
+    ol[1, :nchunks] = lens
+    pad_words = -(-(bucket * 16 + 16) // 128) * 128
+    fn = _sha_chunks_sharded(mesh, bucket, pad_words)
+    ol_dev = jax.device_put(
+        ol, NamedSharding(mesh, P(None, tuple(mesh.axis_names))))
+    digests = np.asarray(fn(block_sh, ol_dev))[:nchunks]
+    return cuts, digests
+
+
 def gear_candidates_sharded(data: bytes | np.ndarray, mask: int,
                             mesh: Mesh) -> np.ndarray:
     """Host-facing sharded candidate scan; same contract (and bit-identical

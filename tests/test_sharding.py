@@ -99,3 +99,77 @@ def test_reduction_step_end_to_end():
     for b, s in [(0, 0), (1, 3), (3, n_bytes // seg - 1)]:
         seg_bytes = blocks[b, s * seg:(s + 1) * seg].tobytes()
         assert digs[b, s].tobytes() == hashlib.sha256(seg_bytes).digest()
+
+
+class TestRealPipelineSharded:
+    """The VERDICT's ask: the ACTUAL variable-chunk pipeline on the mesh,
+    digests asserted against the single-device/native oracle."""
+
+    def test_variable_chunks_match_oracle(self):
+        import jax
+
+        from hdrf_tpu import native
+        from hdrf_tpu.config import CdcConfig
+        from hdrf_tpu.ops.dispatch import gear_mask
+        from hdrf_tpu.parallel import make_mesh, reduce_sharded
+
+        cdc = CdcConfig()
+        mesh = make_mesh(n_data=1, n_seq=len(jax.devices()))
+        rng = np.random.default_rng(61)
+        data = rng.integers(0, 256, size=1_500_000, dtype=np.uint8)
+        data[:400_000] = rng.integers(97, 123, size=400_000, dtype=np.uint8)
+        data[500_000:600_000] = 0
+        data = np.ascontiguousarray(data)
+        cuts, digs = reduce_sharded(data, cdc, mesh)
+        wc = native.cdc_chunk(data, gear_mask(cdc), cdc.min_chunk,
+                              cdc.max_chunk)
+        starts = np.concatenate([[0], wc[:-1]]).astype(np.uint64)
+        wd = native.sha256_batch(data, starts,
+                                 (wc - starts).astype(np.uint64))
+        np.testing.assert_array_equal(np.asarray(cuts), wc)
+        np.testing.assert_array_equal(digs, wd)
+
+    def test_dispatch_routes_multichip(self, monkeypatch):
+        """chunk_and_fingerprint('tpu') on a multi-device host takes the
+        sharded path automatically."""
+        from hdrf_tpu.config import CdcConfig
+        from hdrf_tpu.ops import dispatch
+
+        called = {}
+        import hdrf_tpu.parallel.sharded as sh
+
+        real = sh.reduce_sharded
+
+        def spy(data, cdc, mesh):
+            called["mesh"] = mesh
+            return real(data, cdc, mesh)
+
+        monkeypatch.setattr(sh, "reduce_sharded", spy)
+        rng = np.random.default_rng(62)
+        data = rng.integers(0, 256, size=300_000, dtype=np.uint8)
+        cuts, digs = dispatch.chunk_and_fingerprint(data, CdcConfig(),
+                                                    backend="tpu")
+        assert "mesh" in called, "multichip dispatch did not engage"
+        wc, wd = dispatch.chunk_and_fingerprint(data, CdcConfig(),
+                                                backend="native")
+        np.testing.assert_array_equal(np.asarray(cuts), wc)
+        np.testing.assert_array_equal(digs, wd)
+
+    def test_empty_and_tiny_inputs(self):
+        import jax
+
+        from hdrf_tpu.config import CdcConfig
+        from hdrf_tpu.parallel import make_mesh, reduce_sharded
+
+        mesh = make_mesh(n_data=1, n_seq=len(jax.devices()))
+        cuts, digs = reduce_sharded(b"", CdcConfig(), mesh)
+        assert cuts.size == 0 and digs.shape == (0, 32)
+        from hdrf_tpu import native
+        from hdrf_tpu.ops.dispatch import gear_mask
+
+        cdc = CdcConfig()
+        tiny = np.arange(300, dtype=np.uint8)
+        cuts, digs = reduce_sharded(tiny, cdc, mesh)
+        wc = native.cdc_chunk(tiny, gear_mask(cdc), cdc.min_chunk,
+                              cdc.max_chunk)
+        np.testing.assert_array_equal(np.asarray(cuts), wc)
