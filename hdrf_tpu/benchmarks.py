@@ -163,6 +163,56 @@ def bench_reduction(args) -> None:
                       "MBps": round(mbps, 1), "chunks": int(cuts.size)}))
 
 
+def bench_recon(args) -> None:
+    """Read-side reconstruction MB/s: host path vs device gather path
+    (DataConstructor.java:360-567 vs ops/reconstruct.py).  Builds a dedup
+    store once, then reconstructs blocks repeatedly — the device path's
+    HBM-resident container images make repeat reads gather-only."""
+    import dataclasses
+    import tempfile
+
+    from hdrf_tpu.config import ReductionConfig
+    from hdrf_tpu.index.chunk_index import ChunkIndex
+    from hdrf_tpu.ops.reconstruct import DeviceReconstructor
+    from hdrf_tpu.reduction import scheme as schemes
+    from hdrf_tpu.reduction.scheme import ReductionContext
+    from hdrf_tpu.storage.container_store import ContainerStore
+
+    rng = np.random.default_rng(5)
+    n = args.mb << 20
+    blocks = {}
+    with tempfile.TemporaryDirectory() as d:
+        cfg = ReductionConfig()
+        ctx = ReductionContext(
+            config=cfg,
+            containers=ContainerStore(d + "/containers", codec="lz4"),
+            index=ChunkIndex(d + "/index"), backend="native")
+        s = schemes.get("dedup_lz4")
+        per = 8 << 20
+        for bid in range(n // per):
+            data = rng.integers(0, 256, size=per, dtype=np.uint8)
+            data[: per // 3] = rng.integers(97, 123, size=per // 3,
+                                            dtype=np.uint8)
+            blocks[bid] = data.tobytes()
+            s.reduce(bid, blocks[bid], ctx)
+        for label, rctx in (
+                ("host", ctx),
+                ("device", dataclasses.replace(
+                    ctx, recon=DeviceReconstructor()))):
+            for bid, data in blocks.items():  # warm (stage images/compile)
+                assert s.reconstruct(bid, b"", len(data), rctx) == data
+            t0 = time.perf_counter()
+            total = 0
+            for _ in range(args.repeats):
+                for bid, data in blocks.items():
+                    out = s.reconstruct(bid, b"", len(data), rctx)
+                    total += len(out)
+            mbps = total / (time.perf_counter() - t0) / 2**20
+            print(json.dumps({"op": f"reconstruction [{label}]",
+                              "MBps": round(mbps, 1)}))
+        ctx.index.close()
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="hdrf-bench")
     sub = p.add_subparsers(dest="which", required=True)
@@ -183,6 +233,10 @@ def main(argv: list[str] | None = None) -> int:
     d.add_argument("--mb", type=int, default=64)
     d.add_argument("--backend", default="auto")
     d.set_defaults(fn=bench_reduction)
+    d = sub.add_parser("recon")
+    d.add_argument("--mb", type=int, default=64)
+    d.add_argument("--repeats", type=int, default=3)
+    d.set_defaults(fn=bench_recon)
     args = p.parse_args(argv)
     args.fn(args)
     return 0

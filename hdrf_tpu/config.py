@@ -79,6 +79,12 @@ class ReductionConfig:
     # deployment shape (BASELINE.json; bytes land in the worker's HBM as
     # they stream).  None = in-process compute via ``backend``.
     worker_addr: list | None = None
+    # Device read path: reconstruction-heavy reads gather chunks from
+    # HBM-resident container images (ops/reconstruct.py).  Default OFF:
+    # it wins on PCIe/DMA-attached chips where repeat reads amortize the
+    # image staging; through a slow D2H transport the host path is faster
+    # (measured — PERF_NOTES.md).
+    device_recon: bool = False
     cdc: CdcConfig = field(default_factory=CdcConfig)
 
 

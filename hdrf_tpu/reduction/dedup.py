@@ -40,6 +40,10 @@ from hdrf_tpu.utils import metrics, tracing
 
 _M = metrics.registry("dedup")
 
+# Reads at least this large take the device reconstruction path when a
+# DeviceReconstructor is attached (smaller reads: dispatch overhead wins).
+DEVICE_RECON_MIN = 1 << 20
+
 
 def _block_prep(data, cuts: np.ndarray, digests: np.ndarray):
     """Shared host prep: (memoryview, ordered hash list, first-occurrence
@@ -262,6 +266,15 @@ class DedupScheme(ReductionScheme):
         if pos != entry.logical_len:
             raise IOError(f"block {block_id}: chunk lengths sum to {pos}, "
                           f"index says {entry.logical_len}")
+        if ctx.recon is not None and end - offset >= DEVICE_RECON_MIN:
+            # device read path (DataConstructor -> "Pallas gather" per
+            # SURVEY §2.1): chunks gather from HBM-resident container
+            # images; host pays one ordered copy pass
+            ctx.recon.gather(wanted,
+                             lambda cid: ctx.containers.read_container(cid),
+                             spans, out)
+            _M.incr("blocks_reconstructed_device")
+            return bytes(out)
         chunks = ctx.containers.read_chunks(wanted)
         for chunk, (out_at, lo, n) in zip(chunks, spans):
             out[out_at:out_at + n] = chunk[lo:lo + n]

@@ -47,6 +47,10 @@ class ReductionContext:
     # Co-located reduction worker client (reduction_worker.WorkerClient):
     # when set, schemes offload their hot ops to the worker process.
     worker: object | None = None
+    # Device reconstructor (ops/reconstruct.DeviceReconstructor): when set,
+    # reconstruction-heavy reads gather chunks from HBM-resident container
+    # images instead of host memory.
+    recon: object | None = None
 
 
 class ReductionScheme(ABC):

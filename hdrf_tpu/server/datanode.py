@@ -149,9 +149,15 @@ class DataNode:
             container_size=red.container_size, codec=red.container_codec,
             compress_fn=seal_fn, fsync=red.fsync_containers)
         self.index = ChunkIndex(os.path.join(config.data_dir, "index"))
+        recon = None
+        if red.device_recon and backend == "tpu" and self._worker is None:
+            from hdrf_tpu.ops.reconstruct import DeviceReconstructor
+
+            recon = DeviceReconstructor()
+            self.containers._on_delete = recon.invalidate
         self.reduction_ctx = ReductionContext(
             config=red, containers=self.containers, index=self.index,
-            backend=backend, worker=self._worker)
+            backend=backend, worker=self._worker, recon=recon)
         # Admission control: bounded slots instead of ticket queues.
         self._write_sem = threading.Semaphore(red.max_concurrent_writes)
         self._read_sem = threading.Semaphore(red.max_concurrent_reads)

@@ -74,6 +74,9 @@ class ContainerStore:
         # ReductionConfig.fsync_containers).  Seal-time writes of NEW files
         # still fsync regardless (rename barrier).
         self._fsync = fsync
+        # observer for container deletion (compaction/GC): lets a device
+        # reconstructor drop its stale HBM image
+        self._on_delete = None
         self._alloc_lock = threading.Lock()
         self._next_id = self._scan_next_id()
         self._lanes = [_Lane(threading.Lock()) for _ in range(lanes)]
@@ -342,6 +345,8 @@ class ContainerStore:
                 os.unlink(p)
         with self._cache_lock:
             self._cache.pop(cid, None)
+        if self._on_delete is not None:
+            self._on_delete(cid)
 
     def container_ids(self) -> list[int]:
         ids = set()
