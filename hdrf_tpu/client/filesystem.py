@@ -329,6 +329,14 @@ class HdrfClient:
                 return
             info = self._call("create", path=path, client=self.name,
                                  replication=replication, scheme=scheme)
+            if info.get("encryption"):
+                # transparent client-side encryption (the DFSClient
+                # CryptoOutputStream role): ChaCha20 stream over the file
+                # bytes under the per-file DEK; the DN stores ciphertext
+                enc = info["encryption"]
+                data = native.chacha20_xor(bytes(enc["dek"]),
+                                           bytes(enc["iv"]), data)
+                _M.incr("encrypted_writes")
             block_size = info["block_size"]
             lengths: dict[int, int] = {}
             off = 0
@@ -465,6 +473,19 @@ class HdrfClient:
                 lo = max(offset, bstart) - bstart
                 hi = min(end, bend) - bstart
                 out += self._read_block(binfo, lo, hi - lo)
+            if loc.get("encrypted") and out:
+                # CryptoInputStream role: offset-aware ChaCha20 decrypt —
+                # seek the keystream to the 64-byte block containing
+                # ``offset`` and discard the intra-block prefix.  The DEK
+                # rides the locations response (FileEncryptionInfo).
+                enc = loc.get("encryption") or self._call("decrypt_edek",
+                                                          path=path)
+                pre = offset % 64
+                ks = native.chacha20_xor(
+                    bytes(enc["dek"]), bytes(enc["iv"]),
+                    b"\x00" * pre + bytes(out), counter=1 + offset // 64)
+                out = ks[pre:]
+                _M.incr("encrypted_reads")
             _M.incr("files_read")
             _M.incr("bytes_read", len(out))
             return bytes(out)

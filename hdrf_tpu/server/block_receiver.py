@@ -64,6 +64,20 @@ class BlockReceiver:
     def __init__(self, dn: "DataNode"):
         self._dn = dn
 
+    def _note_peer(self, target: dict, t0: float, nbytes: int) -> None:
+        """Record a downstream-transfer latency sample for slow-peer
+        detection (DataNodePeerMetrics feeding SlowPeerTracker.java:56),
+        normalized to seconds per MB ACTUALLY SENT.  Only the dedicated
+        push leg samples (push_reduced): its whole duration is downstream
+        transfer — the interleaved direct pipeline would misattribute
+        upstream/disk slowness to the peer."""
+        import time as _t
+
+        dn_id = target.get("dn_id")
+        if dn_id and nbytes > 0:
+            self._dn.note_peer_latency(
+                dn_id, (_t.perf_counter() - t0) / max(nbytes / 2**20, 1e-3))
+
     # ------------------------------------------------------------ direct path
 
     def receive_direct(self, sock: socket.socket, fields: dict) -> None:
@@ -266,6 +280,9 @@ class BlockReceiver:
         reconstructing FULL bytes, §3.3 note)."""
         dn = self._dn
         scheme = dn.scheme(scheme_name)
+        import time as _t
+
+        push_t0 = _t.perf_counter()
         mirror = _connect(targets[0]["addr"], dn, block_id)
         try:
             if getattr(scheme, "container_codec", None) is not None:
@@ -287,8 +304,10 @@ class BlockReceiver:
                               for h in needed_hashes]
                 chunks = dn.containers.read_chunks(chunk_locs)
                 seqno = 0
+                sent_bytes = 0
                 for chunk in chunks:
                     dt.write_packet(mirror, seqno, chunk)
+                    sent_bytes += len(chunk)
                     seqno += 1
                 dt.write_packet(mirror, seqno, b"", last=True)
                 _, status = dt.read_ack(mirror)
@@ -302,9 +321,11 @@ class BlockReceiver:
                            hashes=None, targets=targets[1:])
                 recv_frame(mirror)  # symmetric need-frame (always empty here)
                 dt.stream_bytes(mirror, stored, dn.config.packet_size)
+                sent_bytes = len(stored)
                 _, status = dt.read_ack(mirror)
             if status != dt.ACK_SUCCESS:
                 raise IOError(f"mirror returned status {status}")
+            self._note_peer(targets[0], push_t0, max(sent_bytes, 1))
             _M.incr("reduced_mirror_pushes")
         finally:
             mirror.close()

@@ -174,6 +174,10 @@ class DataNode:
         self._threads: list[threading.Thread] = []
         self._ibr_queue: list[tuple[int, int]] = []
         self._ibr_event = threading.Event()
+        # Slow-peer detection inputs (DataNodePeerMetrics analog): rolling
+        # window of normalized downstream-transfer latencies per peer.
+        self._peer_lat: dict[str, list[float]] = {}
+        self._peer_lat_lock = threading.Lock()
 
         outer = self
 
@@ -454,8 +458,24 @@ class DataNode:
                     _M.incr("heartbeat_failures")
                 last_report = now
 
+    def note_peer_latency(self, dn_id: str, s_per_mb: float) -> None:
+        with self._peer_lat_lock:
+            w = self._peer_lat.setdefault(dn_id, [])
+            w.append(s_per_mb)
+            del w[:-64]  # rolling window
+
+    def _peer_report(self) -> dict:
+        """dn_id -> (median s/MB, samples) — rides heartbeats to the NN
+        (SlowPeerReports analog)."""
+        import statistics
+
+        with self._peer_lat_lock:
+            return {d: [statistics.median(w), len(w)]
+                    for d, w in self._peer_lat.items() if w}
+
     def _stats(self) -> dict:
         return {
+            "peer_transfer": self._peer_report(),
             "blocks": len(self.replicas.block_ids()),
             "logical_bytes": sum(m[2] for m in self.replicas.block_report()),
             "physical_bytes": (self.replicas.physical_bytes()

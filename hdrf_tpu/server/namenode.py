@@ -223,6 +223,12 @@ class NameNode:
         self._snapshottable: set[str] = set()
         self._snapshots: dict[str, dict[str, dict]] = {}  # dir -> name -> tree
         self._quotas: dict[str, tuple[int, int]] = {}  # dir -> (ns, space)
+        # Encryption zones (EncryptionZoneManager.java:71 analog): zone
+        # root -> key name; zone keys live WITH the metadata (the owned
+        # KeyProvider replacing the reference's external KMS — key custody
+        # equals metadata custody here, documented trade).
+        self._ezones: dict[str, str] = {}
+        self._ezkeys: dict[str, bytes] = {}
         # Centralized cache management (CacheManager.java:103 analog):
         # pools bound directives; directives pin paths' blocks in DN RAM.
         self._cache_pools: dict[str, dict] = {}   # name -> {owner, limit}
@@ -356,6 +362,8 @@ class NameNode:
             "snapshottable": sorted(self._snapshottable),
             "snapshots": self._snapshots,
             "quotas": {p: list(q) for p, q in self._quotas.items()},
+            "ezones": dict(self._ezones),
+            "ezkeys": {k: bytes(v) for k, v in self._ezkeys.items()},
             "cache_pools": self._cache_pools,
             "cache_dirs": {i: [d["path"], d["pool"]]
                            for i, d in self._cache_dirs.items()},
@@ -394,6 +402,9 @@ class NameNode:
                         for p, q in snap.get("quotas", {}).items()}
         self._next_block_id = snap["next_block_id"]
         self._gen_stamp = snap["gen_stamp"]
+        self._ezones = dict(snap.get("ezones", {}))
+        self._ezkeys = {k: bytes(v)
+                        for k, v in snap.get("ezkeys", {}).items()}
         self._cache_pools = {k: dict(v) for k, v in
                              snap.get("cache_pools", {}).items()}
         self._cache_dirs = {i: {"path": v[0], "pool": v[1]}
@@ -548,6 +559,10 @@ class NameNode:
             parent[name] = SymNode(target, perm.inherit_attrs(
                 self._dir_attrs(parent), rest[0] if rest
                 else self._superuser, None, is_dir=False, umode=0o777))
+        elif op == "ezkey":
+            self._ezkeys[rec[1]] = bytes(rec[2])
+        elif op == "ez":
+            self._ezones["/" + "/".join(self._parts(rec[1]))] = rec[2]
         elif op == "cachepool":
             self._cache_pools[rec[1]] = {"owner": rec[2], "limit": rec[3]}
         elif op == "rmcachepool":
@@ -842,6 +857,22 @@ class NameNode:
         elif op in ("setperm", "setowner", "setacl", "setxattr", "rmxattr",
                     "setpolicy"):
             self._resolve(rec[1])
+        elif op == "ezkey":
+            if rec[1] in self._ezkeys:
+                raise FileExistsError(f"encryption key {rec[1]} exists")
+        elif op == "ez":
+            node = self._resolve(rec[1])
+            if not isinstance(node, dict):
+                raise NotADirectoryError(rec[1])
+            if len(node):
+                raise IOError(f"{rec[1]} is not empty (zones are created "
+                              "on empty directories, as in the reference)")
+            if rec[2] not in self._ezkeys:
+                raise KeyError(f"no encryption key {rec[2]}")
+            p = "/" + "/".join(self._parts(rec[1]))
+            for z in self._ezones:
+                if p == z or p.startswith(z + "/") or z.startswith(p + "/"):
+                    raise IOError(f"nested encryption zones: {z}")
         elif op == "cachepool":
             if rec[1] in self._cache_pools:
                 raise FileExistsError(f"cache pool {rec[1]} exists")
@@ -1039,6 +1070,10 @@ class NameNode:
             node = child
 
     def _delete_apply(self, path: str) -> None:
+        dp = "/" + "/".join(self._parts(path))
+        for z in list(self._ezones):  # deleting a zone (or its ancestor)
+            if z == dp or z.startswith(dp + "/"):
+                del self._ezones[z]
         parent, name = self._parent_of(path)
         node = parent.pop(name, None)
         kept = self._snapshot_referenced()  # (block ids, group ids) to keep
@@ -1150,6 +1185,12 @@ class NameNode:
             self._drop_block(bid)
 
     def _rename_apply(self, src: str, dst: str) -> None:
+        sp = "/" + "/".join(self._parts(src))
+        dp = "/" + "/".join(self._parts(dst))
+        # a renamed ZONE ROOT (or an ancestor of one) carries its zone entry
+        for z in list(self._ezones):
+            if z == sp or z.startswith(sp + "/"):
+                self._ezones[dp + z[len(sp):]] = self._ezones.pop(z)
         sparent, sname = self._parent_of(src)
         node = sparent[sname]
         dparent, dname = self._parent_of(dst, create=True)
@@ -1206,6 +1247,12 @@ class NameNode:
             # (lease recovery only runs on the active), spuriously blocking
             # creates after a promotion.
             self._leases.check_available(path, client)
+            zone = self._zone_of(path)
+            if zone is not None and ec is not None:
+                # validated BEFORE the overwrite delete below: a rejected
+                # create must not destroy the existing file
+                raise IOError("EC files inside encryption zones are not "
+                              "supported")
             if existing is not None:
                 # Overwriting an abandoned incomplete file: drop it first so
                 # its allocated blocks are invalidated on DNs rather than
@@ -1213,10 +1260,29 @@ class NameNode:
                 self._log(["delete", path])
             self._log(["create", path, replication, scheme, time.time(), ec,
                        perm.caller()[0] or self._superuser, mode])
+            enc = None
+            if zone is not None:
+                # per-file DEK wrapped by the zone key; the EDEK persists
+                # as a raw.* xattr (FSDirEncryptionZoneOp semantics), the
+                # RAW dek returns only to this creator (who holds WRITE)
+                import os as _os
+
+                import msgpack as _mp
+
+                from hdrf_tpu import native as _nat
+
+                key_name = self._ezones[zone]
+                dek, iv = _os.urandom(32), _os.urandom(12)
+                edek = _nat.aead_seal(self._ezkeys[key_name], iv,
+                                      self._EZ_AAD, dek)
+                self._log(["setxattr", path, self._EZ_XATTR,
+                           _mp.packb([key_name, iv, edek])])
+                enc = {"dek": dek, "iv": iv}
             self._leases.acquire(path, client)
             _M.incr("create")
             return {"block_size": self.config.block_size, "scheme": scheme,
-                    "replication": replication, "ec": ec}
+                    "replication": replication, "ec": ec,
+                    "encryption": enc}
 
     def rpc_add_block(self, path: str, client: str) -> dict:
         """Allocate the next block + choose target DNs (addBlock RPC ->
@@ -1290,6 +1356,9 @@ class NameNode:
             if node.ec:
                 raise IOError("append to EC files is not supported "
                               "(matches the reference)")
+            if self._EZ_XATTR in node.attrs.xattrs:
+                raise IOError("append to encrypted files is not supported "
+                              "(rewrite-under-new-DEK is the workaround)")
             self._leases.check_available(path, client)
             self._log(["append", path, time.time()])
             self._leases.acquire(path, client)
@@ -1434,9 +1503,17 @@ class NameNode:
                                "token": (self._tokens.mint(bid, "r")
                                          if self._tokens else None),
                                "locations": self._locs_of(bid)})
+            enc = None
+            if self._EZ_XATTR in node.attrs.xattrs:
+                # FileEncryptionInfo-in-LocatedBlocks: the decrypted DEK
+                # rides the same READ-gated response, sparing the client a
+                # second NN round trip per read
+                enc = self._decrypt_edek_locked(node)
             return {"blocks": blocks, "scheme": node.scheme, "ec": None,
                     "length": sum(max(b["length"], 0) for b in blocks),
-                    "complete": node.complete}
+                    "complete": node.complete,
+                    "encrypted": enc is not None,
+                    "encryption": enc}
 
     def _locs_of(self, bid: int) -> list[dict]:
         info = self._blocks[bid]
@@ -1459,6 +1536,11 @@ class NameNode:
         with self._lock:
             self._check_access(src, parent_want=perm.WRITE)
             self._check_access(dst, parent_want=perm.WRITE)
+            if self._zone_of(src) != self._zone_of(dst):
+                # crossing an encryption-zone boundary would detach files
+                # from their zone key (the reference rejects this too)
+                raise IOError("renames across encryption-zone boundaries "
+                              "are not supported")
             self._resolve(src, follow_leaf=False)
             s = "/" + "/".join(self._parts(src))
             d = "/" + "/".join(p for p in dst.split("/") if p)
@@ -1504,6 +1586,80 @@ class NameNode:
         a = self._dir_attrs(node)
         return {"name": name, "type": "dir", "children": len(node),
                 "owner": a.owner, "group": a.group, "mode": a.mode}
+
+    # ----------------------------------------------------- encryption zones
+
+    _EZ_XATTR = "raw.hdrf.crypto"
+    _EZ_AAD = b"hdrf-ez-edek"
+
+    def _zone_of(self, path: str) -> str | None:
+        p = "/" + "/".join(x for x in path.split("/") if x)
+        for z in self._ezones:
+            if p == z or p.startswith(z + "/"):
+                return z
+        return None
+
+    def rpc_create_encryption_key(self, name: str) -> bool:
+        """Key-provider create (the ``hadoop key create`` role).  Keys are
+        journaled: a promoted standby must decrypt EDEKs too."""
+        import os as _os
+
+        with self._lock:
+            self._check_access("/", super_only=True)
+            self._log(["ezkey", name, _os.urandom(32)])
+            _M.incr("ez_keys_created")
+            return True
+
+    def rpc_create_encryption_zone(self, path: str, key_name: str) -> bool:
+        """crypto -createZone (EncryptionZoneManager.java:71): an EMPTY
+        directory becomes a zone; every file created under it gets a
+        per-file DEK wrapped by the zone key."""
+        with self._lock:
+            self._check_access("/", super_only=True)
+            self._log(["ez", path, key_name])
+            _M.incr("ez_created")
+            return True
+
+    def rpc_list_encryption_zones(self) -> dict:
+        """listEncryptionZones is superuser-only, as in the reference —
+        zone roots + key names leak namespace structure otherwise."""
+        with self._lock:
+            self._check_access("/", super_only=True)
+            return dict(self._ezones)
+
+    def rpc_get_ez(self, path: str) -> dict:
+        with self._lock:
+            self._check_access(path)  # traverse
+            z = self._zone_of(path)
+            return {"zone": z, "key": self._ezones.get(z) if z else None}
+
+    def _decrypt_edek_locked(self, node) -> dict | None:
+        from hdrf_tpu import native
+
+        blob = self._node_attrs(node).xattrs.get(self._EZ_XATTR)
+        if blob is None:
+            return None
+        import msgpack as _mp
+
+        key_name, iv, edek = _mp.unpackb(bytes(blob), raw=False)
+        zkey = self._ezkeys.get(key_name)
+        if zkey is None:
+            raise KeyError(f"zone key {key_name} is gone")
+        dek = native.aead_open(zkey, bytes(iv), self._EZ_AAD, bytes(edek))
+        if dek is None:
+            raise IOError("EDEK failed authentication")
+        return {"dek": dek, "iv": bytes(iv)}
+
+    def rpc_decrypt_edek(self, path: str) -> dict:
+        """The KMS-decrypt role: a reader with READ permission on the file
+        gets the file's raw DEK + IV (the zone key itself never leaves the
+        NN)."""
+        with self._lock:
+            self._check_access(path, want=perm.READ)
+            out = self._decrypt_edek_locked(self._resolve(path))
+            if out is None:
+                raise KeyError(f"{path} is not encrypted")
+            return out
 
     # ------------------------------------------------------ cache directives
 
@@ -1672,6 +1828,15 @@ class NameNode:
             for sp in srcs:
                 self._check_access(sp, want=perm.WRITE,
                                    parent_want=perm.WRITE)
+            for pth in [dst, *srcs]:
+                node = self._file(pth)
+                if self._EZ_XATTR in node.attrs.xattrs \
+                        or self._zone_of(pth) is not None:
+                    # per-file DEKs make concatenated ciphertexts
+                    # undecipherable as one stream; the reference forbids
+                    # concat inside encryption zones too
+                    raise IOError("concat of encrypted files / inside "
+                                  "encryption zones is not supported")
             self._log(["concat", dst, list(srcs), time.time()])
             _M.incr("concat")
             return True
@@ -2298,6 +2463,38 @@ class NameNode:
 
     def rpc_metrics(self) -> dict:
         return metrics.all_snapshots()
+
+    def rpc_slow_peers(self) -> dict:
+        """SlowPeerTracker.java:56 analog: aggregate the DNs' peer-latency
+        reports (riding heartbeat stats) and flag peers whose MEDIAN
+        reported transfer latency exceeds 3x the cluster median — the
+        reference's outlier rule, on the same reporter->peer structure."""
+        import statistics
+
+        with self._lock:
+            reports: dict[str, list[float]] = {}
+            for dn in self._datanodes.values():
+                for peer, (med, _n) in (dn.stats.get("peer_transfer")
+                                        or {}).items():
+                    reports.setdefault(peer, []).append(float(med))
+            if not reports:
+                return {"cluster_median_s_per_mb": None, "slow_peers": {}}
+            med_all = statistics.median(
+                [m for ms in reports.values() for m in ms])
+            slow = {}
+            for p, ms in reports.items():
+                # baseline EXCLUDES the candidate's own reports — an
+                # outlier must not inflate the median it is judged against
+                others = [m for q, qs in reports.items() if q != p
+                          for m in qs]
+                base = statistics.median(others) if others else 0.0
+                med_p = statistics.median(ms)
+                if base > 0 and med_p > 3 * base:
+                    slow[p] = {"median_s_per_mb": med_p,
+                               "reporters": len(ms)}
+            return {"cluster_median_s_per_mb": med_all,
+                    "slow_peers": slow,
+                    "reports": {p: len(ms) for p, ms in reports.items()}}
 
     # ---------------------------------------------------------- block mgmt
 

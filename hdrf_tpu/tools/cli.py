@@ -11,7 +11,8 @@ dispatch; DFSAdmin, OfflineImageViewer / OfflineEditsViewer under
                            -createSnapshot -deleteSnapshot -lsSnapshots
                            -chmod -chown -getfacl -setfacl -setfattr -getfattr
   mover                    migrate replicas to satisfy storage policies
-  dfsadmin                 -report -savenamespace -metrics -movblock
+  dfsadmin                 -report -savenamespace -metrics -slowPeers
+                           -movblock
                            -allowSnapshot -setQuota -setSpaceQuota -clrQuota
                            -safemode -decommission -decommissionStatus
                            -haState -transitionToActive
@@ -207,6 +208,8 @@ def cmd_dfsadmin(args) -> int:
             print("namespace saved")
         elif args.op == "-metrics":
             print(json.dumps(c._call("metrics"), indent=2, sort_keys=True))
+        elif args.op == "-slowPeers":
+            print(json.dumps(c._call("slow_peers"), indent=2))
         elif args.op == "-allowSnapshot":
             c.allow_snapshot(args.args[0])
             print(f"snapshots enabled on {args.args[0]}")

@@ -296,3 +296,38 @@ class TestBlockTokens:
                 c.write("/sec/ec", data, ec="rs-3-2-4k")
                 mc.stop_datanode(0)
                 assert c.read("/sec/ec") == data  # degraded read with tokens
+
+
+class TestSlowPeers:
+    def test_slow_peer_reports_aggregate(self):
+        """Per-peer transfer latencies ride heartbeats; the NN flags the
+        3x-median outlier (SlowPeerTracker.java:56 analog)."""
+        import time
+
+        import numpy as np
+
+        from hdrf_tpu.testing.minicluster import MiniCluster
+
+        rng = np.random.default_rng(71)
+        with MiniCluster(n_datanodes=3, replication=2,
+                         block_size=1 << 20) as mc:
+            with mc.client("sp") as c:
+                for i in range(4):
+                    c.write(f"/sp/f{i}",
+                            rng.integers(0, 256, size=200_000,
+                                         dtype=np.uint8).tobytes())
+            # synthesize a pathological peer: dn-2 reported slow by others
+            for dn in mc.datanodes[:2]:
+                for _ in range(8):
+                    dn.note_peer_latency("dn-2", 50.0)  # 50 s/MB
+            deadline = time.time() + 6
+            while time.time() < deadline:
+                rep = mc.namenode.rpc_slow_peers()
+                if "dn-2" in rep["slow_peers"]:
+                    break
+                time.sleep(0.3)
+            else:
+                import pytest
+
+                pytest.fail(f"slow peer never flagged: {rep}")
+            assert rep["slow_peers"]["dn-2"]["reporters"] >= 2
