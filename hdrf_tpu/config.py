@@ -72,6 +72,11 @@ class ReductionConfig:
     # replication; hsync is opt-in per client), and the scanner +
     # re-replication path covers post-crash chunk loss.  The index WAL is
     # always fsync'd (metadata integrity is not replication-recoverable).
+    # CAUTION: because chunks are SHARED, an OS crash that loses one
+    # container corrupts every dedup'd block referencing it on this DN; the
+    # DN cross-checks index-vs-containers at startup and drops affected
+    # blocks so peers re-replicate them — but at replication=1 there IS no
+    # peer: set fsync_containers=True for replication=1 deployments.
     fsync_containers: bool = False
     # Co-located reduction worker (host, port): when set, the DN streams
     # block bytes to this separate worker PROCESS for CDC+SHA (and LZ4

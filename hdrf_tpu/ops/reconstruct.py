@@ -92,6 +92,12 @@ class DeviceReconstructor:
                  | w[:, 3])
         img = jax.device_put(words)
         with self._lock:
+            # two threads can race the staging above for the same cid; the
+            # loser must not double-account the image size (a permanently
+            # inflated _used silently shrinks the budget -> early evictions)
+            if cid in self._images:
+                _M.incr("image_hits")
+                return self._images[cid]
             self._used += a.size
             while self._used > self._budget and self._images:
                 old_cid = next(iter(self._images))

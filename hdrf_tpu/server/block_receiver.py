@@ -28,6 +28,7 @@ end-to-end regardless of the stored form.
 from __future__ import annotations
 
 import socket
+import time
 from typing import TYPE_CHECKING
 
 from hdrf_tpu import native
@@ -64,19 +65,18 @@ class BlockReceiver:
     def __init__(self, dn: "DataNode"):
         self._dn = dn
 
-    def _note_peer(self, target: dict, t0: float, nbytes: int) -> None:
+    def _note_peer(self, target: dict, seconds: float, nbytes: int) -> None:
         """Record a downstream-transfer latency sample for slow-peer
         detection (DataNodePeerMetrics feeding SlowPeerTracker.java:56),
-        normalized to seconds per MB ACTUALLY SENT.  Only the dedicated
-        push leg samples (push_reduced): its whole duration is downstream
-        transfer — the interleaved direct pipeline would misattribute
-        upstream/disk slowness to the peer."""
-        import time as _t
-
+        normalized to seconds per MB ACTUALLY SENT.  ``seconds`` must cover
+        only the downstream portion: the push_reduced leg passes its whole
+        duration (all of it is downstream transfer); the direct pipeline
+        passes the accumulated mirror write + ack-drain time so upstream
+        recv/disk slowness is never misattributed to the peer."""
         dn_id = target.get("dn_id")
         if dn_id and nbytes > 0:
             self._dn.note_peer_latency(
-                dn_id, (_t.perf_counter() - t0) / max(nbytes / 2**20, 1e-3))
+                dn_id, seconds / max(nbytes / 2**20, 1e-3))
 
     # ------------------------------------------------------------ direct path
 
@@ -98,12 +98,17 @@ class BlockReceiver:
                 tail = b""
                 cchunk = dn.checksum_chunk
                 forwarded = 0
+                fwd_bytes = 0
+                mirror_t = 0.0  # downstream-only time (write + ack drain)
                 for seqno, data, last in dt.iter_packets(sock):
                     fault_injection.point("block_receiver.packet",
                                           block_id=block_id, seqno=seqno)
                     if mirror_sock is not None:
+                        _mt0 = time.perf_counter()
                         dt.write_packet(mirror_sock, seqno, data, last)
+                        mirror_t += time.perf_counter() - _mt0
                         forwarded += 1
+                        fwd_bytes += len(data)
                     if data:
                         writer.write(data)
                         tail += data
@@ -120,9 +125,12 @@ class BlockReceiver:
                             # Drain ALL mirror acks (one per forwarded packet);
                             # the final one carries the aggregated downstream
                             # status — earlier ones are flow control.
+                            _mt0 = time.perf_counter()
                             for _ in range(forwarded):
                                 _, down = dt.read_ack(mirror_sock)
                                 status = max(status, down)
+                            mirror_t += time.perf_counter() - _mt0
+                            self._note_peer(targets[0], mirror_t, fwd_bytes)
                         meta = writer.finalize(writer.bytes_written, "direct",
                                                crcs, cchunk)
                         writer = None
@@ -280,9 +288,7 @@ class BlockReceiver:
         reconstructing FULL bytes, §3.3 note)."""
         dn = self._dn
         scheme = dn.scheme(scheme_name)
-        import time as _t
-
-        push_t0 = _t.perf_counter()
+        push_t0 = time.perf_counter()
         mirror = _connect(targets[0]["addr"], dn, block_id)
         try:
             if getattr(scheme, "container_codec", None) is not None:
@@ -325,7 +331,8 @@ class BlockReceiver:
                 _, status = dt.read_ack(mirror)
             if status != dt.ACK_SUCCESS:
                 raise IOError(f"mirror returned status {status}")
-            self._note_peer(targets[0], push_t0, max(sent_bytes, 1))
+            self._note_peer(targets[0], time.perf_counter() - push_t0,
+                            max(sent_bytes, 1))
             _M.incr("reduced_mirror_pushes")
         finally:
             mirror.close()

@@ -206,6 +206,7 @@ class DataNode:
         return self._server.server_address
 
     def start(self) -> "DataNode":
+        self._verify_index_containers()
         t = threading.Thread(target=self._server.serve_forever,
                              name=f"{self.dn_id}-xceiver", daemon=True)
         t.start()
@@ -588,6 +589,43 @@ class DataNode:
             except (OSError, ConnectionError, RpcError):
                 continue  # standby / raced recovery: another NN may accept
         _M.incr("block_recovery_failures")
+
+    def _verify_index_containers(self) -> list[int]:
+        """Startup cross-check: with ``fsync_containers=False`` an OS crash
+        can leave the (always-fsync'd) chunk index referencing container
+        bytes that never reached disk — and since chunks are SHARED, one
+        lost container silently corrupts every dedup'd block referencing
+        it.  Before the first block report advertises anything, verify each
+        referenced container is reachable and drop blocks touching missing
+        ones (the NN re-replicates them from healthy peers; at
+        replication=1 set fsync_containers=True instead — see
+        ReductionConfig)."""
+        referenced = set(self.index.container_live_bytes().keys())
+        missing = set()
+        for c in referenced:
+            # max live extent, not mere existence: the typical crash
+            # artifact is a truncated raw file, not a missing one
+            extent = max((off + ln for off, ln
+                          in self.index.live_chunks_in(c).values()),
+                         default=0)
+            if not self.containers.has_container(c, need_bytes=extent):
+                missing.add(c)
+        if not missing:
+            return []
+        bad: list[int] = []
+        for bid in self.index.block_ids():
+            e = self.index.get_block(bid)
+            if e is None:
+                continue
+            for h in set(e.hashes):
+                loc = self.index.chunk_location(h)
+                if loc is not None and loc.container_id in missing:
+                    bad.append(bid)
+                    break
+        for bid in bad:
+            self._invalidate(bid)
+            _M.incr("startup_lost_container_blocks")
+        return bad
 
     def _invalidate(self, block_id: int) -> None:
         self.cache.unpin(block_id)

@@ -2018,10 +2018,14 @@ class NameNode:
             for k, v in a.xattrs.items():
                 if names is not None and k not in names:
                     continue
-                if k.startswith("trusted.") and user is not None \
-                        and user != self._superuser \
-                        and self.config.permissions_enabled:
-                    continue  # trusted.* hidden from non-superusers
+                # trusted.*, raw.* (wrapped EDEKs live here) and system.*
+                # are confined to the superuser, like the reference's
+                # XAttrPermissionFilter namespace rules
+                if (k.startswith(("trusted.", "raw.", "system."))
+                        and user is not None
+                        and user != self._superuser
+                        and self.config.permissions_enabled):
+                    continue
                 out[k] = bytes(v)
             return out
 
@@ -2464,11 +2468,24 @@ class NameNode:
     def rpc_metrics(self) -> dict:
         return metrics.all_snapshots()
 
+    # Absolute slowness floor for the no-baseline rule: a peer whose median
+    # downstream transfer is worse than 1 MB/s is pathological regardless of
+    # what the rest of the cluster looks like (the reference's low-threshold
+    # guard, OutlierDetector.lowThresholdMs, inverted to a floor).
+    SLOW_PEER_FLOOR_S_PER_MB = 1.0
+
     def rpc_slow_peers(self) -> dict:
         """SlowPeerTracker.java:56 analog: aggregate the DNs' peer-latency
-        reports (riding heartbeat stats) and flag peers whose MEDIAN
-        reported transfer latency exceeds 3x the cluster median — the
-        reference's outlier rule, on the same reporter->peer structure."""
+        reports (riding heartbeat stats) and flag outliers.  Two rules:
+
+        - relative: a peer whose median reported latency exceeds 3x the
+          median of OTHER peers' reports (the reference's outlier rule on
+          the same reporter->peer structure);
+        - absolute: when no other peer has reports (tiny cluster, skewed
+          placement), unanimous multi-reporter slowness past an absolute
+          floor still flags — the reference needs no cross-peer baseline
+          (it detects outliers over the *reported* latencies).
+        """
         import statistics
 
         with self._lock:
@@ -2481,15 +2498,19 @@ class NameNode:
                 return {"cluster_median_s_per_mb": None, "slow_peers": {}}
             med_all = statistics.median(
                 [m for ms in reports.values() for m in ms])
+            floor = self.SLOW_PEER_FLOOR_S_PER_MB
             slow = {}
             for p, ms in reports.items():
-                # baseline EXCLUDES the candidate's own reports — an
+                # relative baseline EXCLUDES the candidate's own reports — an
                 # outlier must not inflate the median it is judged against
                 others = [m for q, qs in reports.items() if q != p
                           for m in qs]
                 base = statistics.median(others) if others else 0.0
                 med_p = statistics.median(ms)
-                if base > 0 and med_p > 3 * base:
+                flagged = base > 0 and med_p > 3 * base
+                if not flagged and base == 0.0:
+                    flagged = len(ms) >= 2 and med_p > floor
+                if flagged:
                     slow[p] = {"median_s_per_mb": med_p,
                                "reporters": len(ms)}
             return {"cluster_median_s_per_mb": med_all,

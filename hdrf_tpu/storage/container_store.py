@@ -348,6 +348,32 @@ class ContainerStore:
         if self._on_delete is not None:
             self._on_delete(cid)
 
+    def has_container(self, cid: int, need_bytes: int = 0) -> bool:
+        """True if the container's bytes are reachable AND cover at least
+        ``need_bytes`` of payload.  The extent check matters: the typical
+        fsync_containers=False crash artifact is a TRUNCATED raw file (the
+        un-fsync'd tail lost to writeback), not a missing one.  Sources:
+        an open lane's memory image, the raw file (size minus header), or
+        the sealed file (uncompressed size from its fsync'd header)."""
+        for lane in self._lanes:
+            with lane.lock:
+                if lane.container_id == cid and lane.image is not None:
+                    return len(lane.image) >= need_bytes
+        try:
+            sz = os.path.getsize(self._raw_path(cid))
+            return sz - _SEAL_HDR.size >= need_bytes
+        except OSError:
+            pass
+        try:
+            with open(self._sealed_path(cid), "rb") as f:
+                hdr = f.read(_SEAL_HDR.size)
+                if len(hdr) < _SEAL_HDR.size:
+                    return False
+                magic, usize, _codec = _SEAL_HDR.unpack(hdr)
+                return magic == _SEAL_MAGIC and usize >= need_bytes
+        except OSError:
+            return False
+
     def container_ids(self) -> list[int]:
         ids = set()
         for name in os.listdir(self._dir):

@@ -331,3 +331,37 @@ class TestSlowPeers:
 
                 pytest.fail(f"slow peer never flagged: {rep}")
             assert rep["slow_peers"]["dn-2"]["reporters"] >= 2
+
+    def test_direct_writes_sample_peer_latency(self):
+        """The stock direct pipeline's mirror leg produces organic latency
+        samples (downstream write + ack-drain time only), so the detector
+        is not blind when no reduced-scheme traffic flows — and healthy
+        peers are NOT flagged (no false positives from the absolute rule)."""
+        import time
+
+        import numpy as np
+
+        from hdrf_tpu.testing.minicluster import MiniCluster
+
+        rng = np.random.default_rng(72)
+        with MiniCluster(n_datanodes=3, replication=2,
+                         block_size=1 << 20) as mc:
+            with mc.client("sp2") as c:
+                for i in range(4):
+                    c.write(f"/sp2/f{i}",
+                            rng.integers(0, 256, size=200_000,
+                                         dtype=np.uint8).tobytes())
+            # DN-side: some head DN recorded a sample about its mirror target
+            assert any(dn._peer_report() for dn in mc.datanodes), \
+                "direct writes produced zero peer-latency samples"
+            # ... and it reaches the NN through heartbeat stats
+            deadline = time.time() + 6
+            while time.time() < deadline:
+                rep = mc.namenode.rpc_slow_peers()
+                if rep.get("reports"):
+                    break
+                time.sleep(0.3)
+            assert rep.get("reports"), \
+                f"no peer reports reached the NN: {rep}"
+            assert rep["slow_peers"] == {}, \
+                f"healthy peers falsely flagged: {rep}"

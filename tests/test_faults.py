@@ -107,3 +107,40 @@ class TestConcurrency:
 
                 with pytest.raises(RpcError, match="leased"):
                     c2._nn.call("create", path="/c/shared", client=c2.name)
+
+
+class TestLostContainerStartup:
+    def test_dn_drops_blocks_with_missing_containers_on_restart(self):
+        """fsync_containers=False crash window: the fsync'd index survives
+        but a container's bytes never hit disk.  On restart the DN must
+        cross-check and drop affected blocks BEFORE advertising them (the
+        startup scanner from ADVICE r3) — the healthy peer still serves."""
+        import glob
+        import os
+
+        rng = np.random.default_rng(41)
+        data = rng.integers(0, 64, size=600_000, dtype=np.uint8).tobytes()
+        with MiniCluster(n_datanodes=2, replication=2,
+                         block_size=1 << 20) as mc:
+            with mc.client("lost") as c:
+                c.write("/lost/f", data, scheme="dedup_lz4")
+                assert c.read("/lost/f") == data
+            dn0_dir = mc.datanodes[0].config.data_dir
+            mc.stop_datanode(0)
+            hit = 0
+            for p in glob.glob(os.path.join(dn0_dir, "containers", "*")):
+                if p.endswith(".raw"):
+                    # the REAL crash artifact: a truncated tail, file present
+                    os.truncate(p, 16)
+                    hit += 1
+                elif p.endswith(".sealed"):
+                    os.unlink(p)
+                    hit += 1
+            assert hit > 0, "expected container files on dn0"
+            dn0 = mc.restart_datanode(0)
+            # the block referencing the lost container was dropped, not served
+            assert dn0.index.block_ids() == []
+            assert dn0.replicas.block_ids() == []
+            # the surviving replica still serves the file
+            with mc.client("lost2") as c:
+                assert c.read("/lost/f") == data
