@@ -108,14 +108,21 @@ class DataNode:
         self.config = config
         self.checksum_chunk = 64 * 1024
         red = config.reduction
-        os.makedirs(config.data_dir, exist_ok=True)
+        # Layout check/upgrade BEFORE anything opens the store (the
+        # reference's Storage.analyzeStorage + doUpgrade at startup): a
+        # flat pre-volume dir is migrated to volumes/vol-0 with a
+        # rollback snapshot under previous/.
+        from hdrf_tpu.storage import version as storage_version
+
+        storage_version.ensure_layout(config.data_dir, "datanode",
+                                      storage_version.DN_UPGRADERS)
+        vol0 = os.path.join(config.data_dir, "volumes", "vol-0")
         if config.simulated_dataset:
             from hdrf_tpu.storage.simulated import SimulatedReplicaStore
 
             self.replicas = SimulatedReplicaStore()
         else:
-            self.replicas = ReplicaStore(
-                os.path.join(config.data_dir, "replicas"))
+            self.replicas = ReplicaStore(os.path.join(vol0, "replicas"))
         backend = ops_dispatch.resolve_backend(red.backend)
         # Seal entropy stage (the reference's rollover LZ4,
         # DataDeduplicator.java:770-781), most-capable-first: the
@@ -145,7 +152,7 @@ class DataNode:
             seal_fn = (lambda data:
                        ops_dispatch.block_compress("lz4", data, "tpu"))
         self.containers = ContainerStore(
-            os.path.join(config.data_dir, "containers"),
+            os.path.join(vol0, "containers"),
             container_size=red.container_size, codec=red.container_codec,
             compress_fn=seal_fn, fsync=red.fsync_containers)
         self.index = ChunkIndex(os.path.join(config.data_dir, "index"))
@@ -504,6 +511,11 @@ class DataNode:
         elif cmd["cmd"] == "uncache":
             for bid in cmd["block_ids"]:
                 self.cache.unpin(bid)
+        elif cmd["cmd"] == "finalize_upgrade":
+            from hdrf_tpu.storage import version as storage_version
+
+            if storage_version.finalize_upgrade(self.config.data_dir):
+                _M.incr("upgrades_finalized")
 
     def _peer_call(self, addr, op: str, **fields) -> dict:
         """One-shot framed request to a peer DN's xceiver (recovery ops)."""

@@ -200,7 +200,10 @@ class JournalNode:
     def __init__(self, directory: str, host: str = "127.0.0.1",
                  port: int = 0):
         self._dir = directory
-        os.makedirs(directory, exist_ok=True)
+        from hdrf_tpu.storage import version as storage_version
+
+        storage_version.ensure_layout(directory, "journal",
+                                      storage_version.JN_UPGRADERS)
         self._lock = threading.Lock()
         self._promised = self._read_int(EPOCH_NAME, 0)
         self._last_write_epoch = self._read_int("wepoch", 0)

@@ -202,6 +202,7 @@ class NameNode:
         self._datanodes: dict[str, DatanodeInfo] = {}
         self._leases = LeaseManager()
         self._pending_repl: dict[int, float] = {}  # block_id -> retry deadline
+        self._under_replicated = 0  # cached by _check_replication
         # balancer moves in flight: block -> {"from", "to", "deadline"}
         self._pending_moves: dict[int, dict] = {}
         self._pending_ibr: dict[int, list] = {}    # standby: IBRs ahead of tail
@@ -246,6 +247,12 @@ class NameNode:
         self._tokens = (BlockTokenSecretManager()
                         if self.config.block_tokens else None)
         self._dtokens = DelegationTokenManager()
+        # layout check/upgrade before the edit log opens the meta dir
+        # (Storage.analyzeStorage; a future-layout dir refuses to load)
+        from hdrf_tpu.storage import version as storage_version
+
+        storage_version.ensure_layout(self.config.meta_dir, "namenode",
+                                      storage_version.NN_UPGRADERS)
         self._editlog = EditLog(self.config.meta_dir,
                                 self.config.editlog_checkpoint_every,
                                 journal_addrs=self.config.journal_addrs)
@@ -2374,6 +2381,62 @@ class NameNode:
                      "blocks": len(d.blocks), "stats": d.stats}
                     for d in self._datanodes.values()]
 
+    def rpc_cluster_status(self) -> dict:
+        """Cluster overview backing the dfshealth web UI — the aggregate
+        fields of the reference's webapps/hdfs/dfshealth.html and
+        NameNodeMXBean (capacity, DN liveness buckets, block totals,
+        safemode, journal wiring)."""
+        with self._lock:
+            now = time.monotonic()
+            live = dead = decom = 0
+            logical = physical = cached = 0
+            for d in self._datanodes.values():
+                alive = (now - d.last_heartbeat
+                         < self.config.dead_node_interval_s)
+                if d.dn_id in self._decommissioning:
+                    decom += 1
+                elif alive:
+                    live += 1
+                else:
+                    dead += 1
+                st = d.stats or {}
+                logical += int(st.get("logical_bytes", 0))
+                physical += int(st.get("physical_bytes", 0))
+                cached += int(st.get("cache_used", 0))
+            # The under-replicated count is the redundancy monitor's own
+            # (cached each _check_replication tick) — recomputing it here
+            # would both duplicate the want/counted semantics and walk
+            # every block under the namesystem lock per page load.
+            under = self._under_replicated
+            return {
+                "role": self.role,
+                "safemode": self._in_safemode(),
+                "blocks": len(self._blocks),
+                "under_replicated": under,
+                "pending_replication": len(self._pending_repl),
+                "live": live, "dead": dead, "decommissioning": decom,
+                "logical_bytes": logical, "physical_bytes": physical,
+                "cache_used": cached,
+                "editlog_seq": self._editlog.seq,
+                "journal_addrs": [list(a) for a in
+                                  (self.config.journal_addrs or [])],
+            }
+
+    def rpc_finalize_upgrade(self) -> dict:
+        """dfsadmin -finalizeUpgrade: drop this NameNode's rollback
+        snapshot and queue a finalize command to every DataNode (the
+        reference propagates finalization through heartbeat responses)."""
+        from hdrf_tpu.storage import version as storage_version
+
+        with self._lock:
+            self._check_access("/", super_only=True)
+            nn = storage_version.finalize_upgrade(self.config.meta_dir)
+            queued = 0
+            for d in self._datanodes.values():
+                d.commands.append({"cmd": "finalize_upgrade"})
+                queued += 1
+            return {"namenode_finalized": nn, "datanodes_queued": queued}
+
     def rpc_save_namespace(self) -> bool:
         with self._lock:
             self._check_access("/", super_only=True)
@@ -2965,6 +3028,7 @@ class NameNode:
             now = time.monotonic()
             self._check_ec_groups(now)
             ec_bids = {b for g in self._groups.values() for b in g.bids}
+            under = 0
             for info in self._blocks.values():
                 node = self._try_file(info.path)
                 if node is None or not node.complete:
@@ -2976,6 +3040,8 @@ class NameNode:
                 live = {d for d in info.locations if d in self._datanodes}
                 counted = live - self._decommissioning
                 deficit = want - len(counted)
+                if deficit > 0 and live:
+                    under += 1
                 if deficit <= 0 or not live:
                     self._pending_repl.pop(info.block_id, None)
                     if (deficit < 0
@@ -2998,6 +3064,9 @@ class NameNode:
                     self._pending_repl[info.block_id] = (
                         now + self.config.pending_replication_timeout_s)
                     _M.incr("replications_scheduled")
+            # cached for rpc_cluster_status: the dfshealth page must not
+            # re-walk every block under the namesystem lock per page load
+            self._under_replicated = under
 
     def _prune_excess(self, info, counted: set[str], want: int) -> None:
         """Drop excess replicas (BlockManager.processExtraRedundancy /

@@ -99,10 +99,15 @@ class ReplicaWriter:
                          self._written, scheme, checksum_chunk, checksums or [])
         dst = self._store._path(FINALIZED, self.block_id)
         os.replace(self._path, dst)
-        with open(dst + ".meta", "wb") as f:
+        # write-replace, never open("wb") the existing meta: on a supersede
+        # rewrite (append/recovery finalize) the old meta may be hardlinked
+        # into an upgrade snapshot (storage/version.py), and truncating the
+        # shared inode would corrupt the rollback image
+        with open(dst + ".meta.tmp", "wb") as f:
             f.write(meta.pack())
             f.flush()
             os.fsync(f.fileno())
+        os.replace(dst + ".meta.tmp", dst + ".meta")
         self._store._register(meta)
         _M.incr("finalized")
         return meta
@@ -220,10 +225,17 @@ class ReplicaStore:
                     raise IOError(f"block {block_id}: cannot truncate a "
                                   f"{meta.scheme} replica to {new_len}")
                 p = self._path(FINALIZED, block_id)
-                with open(p, "r+b") as f:
-                    f.truncate(new_len)
+                # write-replace, never truncate in place: finalized data
+                # files are hardlinked into upgrade snapshots
+                # (storage/version.py _snapshot), so an in-place mutation
+                # would silently corrupt the rollback image
+                with open(p, "rb") as f:
+                    kept = f.read(new_len)
+                with open(p + ".tmp", "wb") as f:
+                    f.write(kept)
                     f.flush()
                     os.fsync(f.fileno())
+                os.replace(p + ".tmp", p)
                 nchunks = -(-new_len // meta.checksum_chunk) if new_len else 0
                 meta.logical_len = meta.physical_len = new_len
                 del meta.checksums[nchunks:]
@@ -237,10 +249,11 @@ class ReplicaStore:
             if new_gs is not None and new_gs > meta.gen_stamp:
                 meta.gen_stamp = new_gs
             mp = self._path(FINALIZED, block_id) + ".meta"
-            with open(mp, "wb") as f:
+            with open(mp + ".tmp", "wb") as f:
                 f.write(meta.pack())
                 f.flush()
                 os.fsync(f.fileno())
+            os.replace(mp + ".tmp", mp)  # write-replace: see above
             return True
 
     def delete(self, block_id: int) -> None:

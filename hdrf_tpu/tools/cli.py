@@ -210,6 +210,10 @@ def cmd_dfsadmin(args) -> int:
             print(json.dumps(c._call("metrics"), indent=2, sort_keys=True))
         elif args.op == "-slowPeers":
             print(json.dumps(c._call("slow_peers"), indent=2))
+        elif args.op == "-finalizeUpgrade":
+            r = c._call("finalize_upgrade")
+            print(f"finalized: namenode={r['namenode_finalized']} "
+                  f"datanodes_queued={r['datanodes_queued']}")
         elif args.op == "-allowSnapshot":
             c.allow_snapshot(args.args[0])
             print(f"snapshots enabled on {args.args[0]}")
@@ -265,6 +269,26 @@ def cmd_dfsadmin(args) -> int:
 
 
 # ------------------------------------------------------------------- oiv/oev
+
+def cmd_storage(args) -> int:
+    """Offline storage-dir maintenance (Storage.java state machine): show
+    the VERSION file, roll a store back to its pre-upgrade snapshot
+    (namenode -rollback analog), or finalize (drop the snapshot).  The
+    daemon owning the dir must be stopped."""
+    from hdrf_tpu.storage import version as storage_version
+
+    if args.action == "version":
+        v = storage_version.read_version(args.directory)
+        print(json.dumps(v if v is not None
+                         else {"layoutVersion": 0, "unversioned": True}))
+    elif args.action == "rollback":
+        storage_version.rollback(args.directory)
+        print(f"rolled back {args.directory}")
+    elif args.action == "finalize":
+        had = storage_version.finalize_upgrade(args.directory)
+        print("finalized" if had else "nothing to finalize")
+    return 0
+
 
 def cmd_oiv(args) -> int:
     """Offline image viewer: dump the fsimage namespace as JSON lines
@@ -407,6 +431,11 @@ def main(argv: list[str] | None = None) -> int:
     d.add_argument("--namenode", required=True)
     d.add_argument("--secure", action="store_true")
     d.set_defaults(fn=cmd_dfsadmin, takes_ops=True)
+
+    d = sub.add_parser("storage")
+    d.add_argument("action", choices=["version", "rollback", "finalize"])
+    d.add_argument("directory")
+    d.set_defaults(fn=cmd_storage)
 
     d = sub.add_parser("oiv")
     d.add_argument("meta_dir")

@@ -128,7 +128,8 @@ class TestLostContainerStartup:
             dn0_dir = mc.datanodes[0].config.data_dir
             mc.stop_datanode(0)
             hit = 0
-            for p in glob.glob(os.path.join(dn0_dir, "containers", "*")):
+            for p in glob.glob(os.path.join(dn0_dir, "volumes", "vol-0",
+                                            "containers", "*")):
                 if p.endswith(".raw"):
                     # the REAL crash artifact: a truncated tail, file present
                     os.truncate(p, 16)
