@@ -72,6 +72,13 @@ class HttpGateway:
                     if u.path == "/explorer":
                         return self._html(gateway.explorer(
                             q.get("path", "/")))
+                    if u.path in ("/", "/dfshealth"):
+                        return self._html(gateway.dfshealth())
+                    if u.path == "/datanode":
+                        return self._html(gateway.datanode_page(
+                            q.get("id", "")))
+                    if u.path == "/journal":
+                        return self._html(gateway.journal_page())
                     if u.path == "/status":
                         return self._json(200, gateway.status())
                     if u.path == "/metrics":
@@ -154,6 +161,142 @@ class HttpGateway:
     def metrics(self) -> dict:
         with HdrfClient(self._nn_addr, name="http-gw") as c:
             return c._call("metrics")
+
+    # ------------------------------------------------------------- web UIs
+
+    _NAV = ('<p><a href="/dfshealth">[overview]</a> '
+            '<a href="/explorer?path=%2F">[explorer]</a> '
+            '<a href="/journal">[journal]</a> '
+            '<a href="/status">[status.json]</a> '
+            '<a href="/metrics">[metrics.json]</a></p>')
+
+    @staticmethod
+    def _page(title: str, body: str) -> str:
+        import html
+
+        return (f"<html><head><title>{html.escape(title)}</title>"
+                "<style>body{font-family:sans-serif;margin:2em}"
+                "table{border-collapse:collapse}"
+                "td,th{border:1px solid #ccc;padding:4px 10px}"
+                "th{background:#eee}</style></head>"
+                f"<body><h2>{html.escape(title)}</h2>"
+                f"{HttpGateway._NAV}{body}</body></html>")
+
+    @staticmethod
+    def _gb(n) -> str:
+        return f"{(n or 0) / 2**30:.2f} GB"
+
+    def dfshealth(self) -> str:
+        """NameNode overview (webapps/hdfs/dfshealth.html analog): safemode,
+        HA role, capacity, block totals, and the live/dead/decommissioning
+        DataNode table with per-DN drill-down links."""
+        import html
+        from urllib.parse import quote
+
+        with HdrfClient(self._nn_addr, name="http-gw") as c:
+            cs = c._call("cluster_status")
+            report = c.datanode_report()
+        rows = []
+        for d in sorted(report, key=lambda x: x["dn_id"]):
+            st = d.get("stats") or {}
+            state = "live" if d["alive"] else "dead"
+            url = "/datanode?id=" + quote(d["dn_id"], safe="")
+            rows.append(
+                f'<tr><td><a href="{html.escape(url, quote=True)}">'
+                f'{html.escape(d["dn_id"])}</a></td>'
+                f"<td>{html.escape(':'.join(map(str, d['addr'])))}</td>"
+                f"<td>{state}</td><td align=right>{d['blocks']}</td>"
+                f"<td align=right>{self._gb(st.get('logical_bytes'))}</td>"
+                f"<td align=right>{self._gb(st.get('physical_bytes'))}</td>"
+                "</tr>")
+        summary = (
+            f"<table><tr><th>role</th><td>{html.escape(cs['role'])}</td></tr>"
+            f"<tr><th>safemode</th><td>{'ON' if cs['safemode'] else 'off'}"
+            "</td></tr>"
+            f"<tr><th>blocks</th><td>{cs['blocks']}</td></tr>"
+            f"<tr><th>under-replicated</th><td>{cs['under_replicated']}"
+            "</td></tr>"
+            f"<tr><th>pending replication</th>"
+            f"<td>{cs['pending_replication']}</td></tr>"
+            f"<tr><th>logical data</th>"
+            f"<td>{self._gb(cs['logical_bytes'])}</td></tr>"
+            f"<tr><th>physical (reduced) data</th>"
+            f"<td>{self._gb(cs['physical_bytes'])}</td></tr>"
+            f"<tr><th>edit log seq</th><td>{cs['editlog_seq']}</td></tr>"
+            f"<tr><th>datanodes</th><td>{cs['live']} live / {cs['dead']} "
+            f"dead / {cs['decommissioning']} decommissioning</td></tr>"
+            "</table>")
+        dn_table = ("<h3>DataNodes</h3><table><tr><th>id</th><th>addr</th>"
+                    "<th>state</th><th>blocks</th><th>logical</th>"
+                    "<th>physical</th></tr>" + "".join(rows) + "</table>")
+        return self._page("hdrf_tpu NameNode", summary + dn_table)
+
+    def datanode_page(self, dn_id: str) -> str:
+        """Per-DataNode detail (webapps/datanode analog), rendered from the
+        stats the DN ships in heartbeats: replica/container bytes, pinned
+        cache, chunk-index state, peer-latency reports."""
+        import html
+
+        with HdrfClient(self._nn_addr, name="http-gw") as c:
+            report = c.datanode_report()
+        d = next((x for x in report if x["dn_id"] == dn_id), None)
+        if d is None:
+            return self._page(f"datanode {dn_id}", "<p>unknown datanode</p>")
+        st = d.get("stats") or {}
+        idx = st.get("index") or {}
+        rows = [
+            ("state", "live" if d["alive"] else "dead"),
+            ("address", ":".join(map(str, d["addr"]))),
+            ("blocks", d["blocks"]),
+            ("logical bytes", self._gb(st.get("logical_bytes"))),
+            ("physical bytes", self._gb(st.get("physical_bytes"))),
+            ("cached blocks", len(st.get("cached_blocks") or [])),
+            ("cache used", self._gb(st.get("cache_used"))),
+        ] + [(f"index {k}", v) for k, v in sorted(idx.items())] + [
+            (f"peer {p} median s/MB", f"{m:.3f} ({n} samples)")
+            for p, (m, n) in sorted((st.get("peer_transfer") or {}).items())
+        ]
+        body = "<table>" + "".join(
+            f"<tr><th>{html.escape(str(k))}</th>"
+            f"<td>{html.escape(str(v))}</td></tr>" for k, v in rows) \
+            + "</table>"
+        return self._page(f"hdrf_tpu DataNode {dn_id}", body)
+
+    def journal_page(self) -> str:
+        """JournalNode quorum state (webapps/journal analog): per-node
+        epoch, accepted/committed sequence, storage dir."""
+        import html
+
+        from hdrf_tpu.proto.rpc import RpcClient
+
+        with HdrfClient(self._nn_addr, name="http-gw") as c:
+            cs = c._call("cluster_status")
+        addrs = cs.get("journal_addrs") or []
+        if not addrs:
+            body = ("<p>no quorum journal configured (shared-directory "
+                    f"edit log; seq {cs['editlog_seq']})</p>")
+            return self._page("hdrf_tpu Journal", body)
+        rows = []
+        for a in addrs:
+            addr = (a[0], int(a[1]))
+            try:
+                # short probe timeout: a packet-dropping (not refusing) JN
+                # must not stall the page for the default 30 s per node
+                with RpcClient(addr, timeout=2.0) as jc:
+                    s = jc.call("jn_state")
+                cells = [f"{a[0]}:{a[1]}", "up"] + [
+                    str(s.get(k)) for k in ("promised", "wepoch",
+                                            "last_seq", "earliest")]
+            except (OSError, ConnectionError) as e:
+                cells = [f"{a[0]}:{a[1]}", f"down ({type(e).__name__})",
+                         "-", "-", "-", "-"]
+            rows.append("<tr>" + "".join(
+                f"<td>{html.escape(c)}</td>" for c in cells) + "</tr>")
+        body = ("<table><tr><th>node</th><th>state</th>"
+                "<th>promised epoch</th><th>write epoch</th>"
+                "<th>last seq</th><th>earliest</th></tr>"
+                + "".join(rows) + "</table>")
+        return self._page("hdrf_tpu Journal", body)
 
     def explorer(self, path: str) -> str:
         """Minimal namespace browser (the NN webapp's explorer.html analog).

@@ -311,3 +311,29 @@ class TestQuorumHaCluster:
                 assert len(st) == 30
             finally:
                 sb.stop()
+
+    def test_journal_web_page_renders_quorum_state(self):
+        """webapps/journal analog: the gateway's /journal page shows each
+        JournalNode's epoch/sequence state, and marks a downed node."""
+        import urllib.request
+
+        from hdrf_tpu.server.http_gateway import HttpGateway
+        from hdrf_tpu.testing.minicluster import MiniCluster
+
+        with MiniCluster(n_datanodes=1, replication=1, ha=True,
+                         journal_nodes=3) as mc:
+            with mc.client("jw") as c:
+                c.write("/jw/a", b"j" * 5000, scheme="direct")
+            gw = HttpGateway(mc.namenode.addr).start()
+            try:
+                base = f"http://{gw.addr[0]}:{gw.addr[1]}"
+                with urllib.request.urlopen(base + "/journal") as r:
+                    page = r.read().decode()
+                assert page.count("<td>up</td>") == 3
+                assert "promised epoch" in page
+                mc.stop_journalnode(2)
+                with urllib.request.urlopen(base + "/journal") as r:
+                    page = r.read().decode()
+                assert page.count("<td>up</td>") == 2 and "down" in page
+            finally:
+                gw.stop()
