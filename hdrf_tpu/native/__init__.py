@@ -68,6 +68,10 @@ def _load() -> ctypes.CDLL:
         lib.hdrf_lz4_compress_bound.restype = ctypes.c_uint64
         lib.hdrf_lz4_compress.argtypes = [_u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64]
         lib.hdrf_lz4_compress.restype = ctypes.c_uint64
+        lib.hdrf_lz4_compress_tail.argtypes = [
+            _u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+        lib.hdrf_lz4_compress_tail.restype = ctypes.c_uint64
         lib.hdrf_lz4_decompress.argtypes = [_u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64]
         lib.hdrf_lz4_decompress.restype = ctypes.c_uint64
         lib.hdrf_lz4_emit.argtypes = [_u8p, ctypes.c_uint64, _i32p, _u32p,
@@ -176,6 +180,25 @@ def lz4_compress(data: bytes | np.ndarray) -> bytes:
     if n == 0:
         raise RuntimeError("lz4 compression failed")
     return out[:n].tobytes()
+
+
+def lz4_compress_tail(data: bytes | np.ndarray) -> tuple[bytes, int, int]:
+    """lz4_compress plus (tail_token_offset, tail_literal_count) of the
+    stream's final literals-only sequence — what the parallel segmented
+    compressor's stitcher needs (ops/lz4_tpu.lz4_stitch)."""
+    a = _as_u8(data)
+    if a.size == 0:
+        return b"", 0, 0
+    cap = _load().hdrf_lz4_compress_bound(a.size)
+    out = np.empty(cap, dtype=np.uint8)
+    toff = ctypes.c_uint64()
+    tlit = ctypes.c_uint64()
+    n = _load().hdrf_lz4_compress_tail(_ptr(a, _u8p), a.size, _ptr(out, _u8p),
+                                       cap, ctypes.byref(toff),
+                                       ctypes.byref(tlit))
+    if n == 0:
+        raise RuntimeError("lz4 compression failed")
+    return out[:n].tobytes(), toff.value, tlit.value
 
 
 def lz4_emit(data: bytes | np.ndarray, positions: np.ndarray,

@@ -115,6 +115,46 @@ uint64_t hdrf_lz4_compress(const uint8_t *src, uint64_t srclen, uint8_t *dst,
   return uint64_t(op - dst);
 }
 
+// hdrf_lz4_compress + tail-sequence report, for parallel segmented
+// compression (ops/lz4_tpu._lz4_compress_parallel): segments compress
+// independently and are STITCHED into one spec-valid block stream by
+// merging each junction's literal tail into the next segment's first
+// sequence.  The stitcher needs to know where this stream's final
+// (literals-only) sequence begins and how many literals it carries —
+// information only the encoder has (the block format has no end marker;
+// the tail is recognized purely by reaching end-of-input).
+uint64_t hdrf_lz4_compress_tail(const uint8_t *src, uint64_t srclen,
+                                uint8_t *dst, uint64_t dstcap,
+                                uint64_t *tail_off, uint64_t *tail_lit) {
+  uint64_t n = hdrf_lz4_compress(src, srclen, dst, dstcap);
+  if (n == 0) return 0;
+  // Walk the sequences to the last one.  O(sequences), no byte copying;
+  // done here (not in the encoder body) to keep the hot loop untouched.
+  const uint8_t *p = dst;
+  const uint8_t *pend = dst + n;
+  const uint8_t *tok = p;
+  for (;;) {
+    tok = p;
+    uint8_t t = *p++;
+    uint64_t lit = t >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do { b = *p++; lit += b; } while (b == 255);
+    }
+    p += lit;
+    if (p >= pend) {          // literals reach end-of-stream: final sequence
+      *tail_off = uint64_t(tok - dst);
+      *tail_lit = lit;
+      return n;
+    }
+    p += 2;                   // match offset
+    if ((t & 0x0F) == 15) {
+      uint8_t b;
+      do { b = *p++; } while (b == 255);
+    }
+  }
+}
+
 // Assemble an LZ4 block from externally discovered match records.
 //
 // This is the host half of the TPU LZ4 path (ops/lz4_tpu.py): the device

@@ -59,17 +59,21 @@ serial encoder; degenerate RLE is excluded from the sort and recovered
 exactly by the emit's constant-offset probes (zeros: identical ratio);
 short-match-DENSE data (word-soup text, TeraGen rows at ~9 records per
 100-byte row) exceeds the record-flood cap and falls back to the native
-encoder outright — identical ratio by construction, and an adaptive bypass
-skips the pointless scans once a stream shows its character.  Grey-zone
-containers additionally race the native encoder and keep the smaller
-stream, so the stage's ratio is >= the CPU scheme's on EVERY container.
+encoder outright — same encoder as the CPU scheme, within the segmented
+path's junction-window loss (<0.02% measured, see _SEG) — and an adaptive
+bypass skips the pointless scans once a stream shows its character.
+Grey-zone containers additionally race the native encoder (decided on a
+mid-container sample; full race when the sample is close) and keep the
+smaller stream.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
 
 import jax
 import jax.numpy as jnp
@@ -78,6 +82,104 @@ import numpy as np
 from hdrf_tpu.utils import metrics as _metrics
 
 _M_FLOOD = _metrics.registry("lz4_tpu")
+
+# Segment width for host-parallel native LZ4 (flood fallback / bypass).
+# Segments compress independently on a thread pool, then lz4_stitch merges
+# them into ONE spec-valid LZ4 block stream (plain concatenation is NOT
+# valid: the block format has no end marker, so each piece's final
+# literals-only sequence would derail a decoder mid-stream).  Cost is only
+# ratio: positions early in a segment lose their back-window (offsets never
+# cross a junction) — LZ4's window is 64 KiB, so at 8 MiB segments <1% of
+# positions are affected and on periodic data they re-match within their
+# own segment; measured loss on a TeraGen container is <0.02%.
+_SEG = 8 << 20
+
+
+def _seq_head(lit_len: int, match_nibble: int) -> bytes:
+    """Token + extended-length bytes for a sequence with ``lit_len``
+    literals and the given low (match-length) nibble."""
+    if lit_len < 15:
+        return bytes([(lit_len << 4) | match_nibble])
+    out = [0xF0 | match_nibble]
+    rem = lit_len - 15
+    while rem >= 255:
+        out.append(255)
+        rem -= 255
+    out.append(rem)
+    return bytes(out)
+
+
+def lz4_stitch(pieces: list[tuple[bytes, int, int]]) -> bytes:
+    """Merge independently compressed LZ4 block streams into one valid
+    stream.  ``pieces`` are (stream, tail_token_off, tail_lit) from
+    ``native.lz4_compress_tail``.  At each junction the left piece's final
+    literals-only sequence is folded into the right piece's first sequence
+    (lit runs concatenate; the match half is byte-identical, offsets being
+    relative and segment-internal).  End-of-block restrictions hold because
+    the final piece's tail is kept verbatim."""
+    out = bytearray()
+    pend_lits = b""   # literals awaiting the next sequence-with-a-match
+    for stream, tail_off, tail_lit in pieces:
+        body, tail = stream[:tail_off], stream[tail_off:]
+        tail_literals = tail[-tail_lit:] if tail_lit else b""
+        if body:
+            if pend_lits:
+                # fold pending literals into body's FIRST sequence
+                t = body[0]
+                lit = t >> 4
+                p = 1
+                if lit == 15:
+                    while True:
+                        b = body[p]
+                        p += 1
+                        lit += b
+                        if b != 255:
+                            break
+                first_lits = body[p:p + lit]
+                rest = body[p + lit:]   # offset+matchlen ext of seq 1 onward
+                out += _seq_head(len(pend_lits) + lit, t & 0x0F)
+                out += pend_lits
+                out += first_lits
+                out += rest
+                pend_lits = b""
+            else:
+                out += body
+            pend_lits = tail_literals
+        else:
+            # piece is a single literals-only sequence (tiny/incompressible
+            # segment): just accumulate its literals
+            pend_lits += tail_literals
+    out += _seq_head(len(pend_lits), 0)
+    out += pend_lits
+    return bytes(out)
+
+
+_POOL: ThreadPoolExecutor | None = None
+_POOL_LOCK = threading.Lock()
+
+
+def _pool() -> ThreadPoolExecutor:
+    """Process-shared host-compression pool, created on first parallel use
+    (a per-instance pool would leak 4 threads per TpuLz4 for the process
+    lifetime; instances share one encoder workload anyway)."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = ThreadPoolExecutor(
+                min(4, os.cpu_count() or 1), thread_name_prefix="lz4host")
+        return _POOL
+
+
+def _lz4_compress_parallel(a: np.ndarray) -> bytes:
+    from hdrf_tpu import native
+
+    # On a single-core host the segmented path only adds overhead (the
+    # native calls release the GIL but there is no second core to use it);
+    # the dev environment's DN hosts are 1-vCPU, real DN hosts are not.
+    if a.size <= _SEG or (os.cpu_count() or 1) <= 1:
+        return bytes(native.lz4_compress(a))
+    parts = [a[o:o + _SEG] for o in range(0, a.size, _SEG)]
+    return lz4_stitch(list(_pool().map(native.lz4_compress_tail, parts)))
 
 _HASH_MUL = np.uint32(2654435761)  # golden-ratio multiplier (lz4.cpp hash4)
 _S = 131072         # supertile span in bytes; window <= LZ4's 65535 anyway
@@ -358,8 +460,9 @@ class TpuLz4:
                     # Record flood (> ~8k records/MiB ~= a sequence every
                     # <128 B): short-match-dense data is the serial
                     # hash-table encoder's home turf and the sort scan's
-                    # worst case — the native encoder takes over, keeping
-                    # ratio EXACTLY equal to the CPU scheme there.
+                    # worst case — the native encoder takes over (same
+                    # encoder as the CPU scheme, within the segmented
+                    # path's junction-window loss, see _SEG).
                     break
                 t3 = max(e_cap // _E3, 1)
                 self._p3 = max(self._p3, min(need, e_cap))
@@ -386,37 +489,67 @@ class TpuLz4:
             # Record flood the slices can't represent: short-match-dense
             # data (e.g. word-soup text needs a sequence every ~9 bytes) is
             # exactly where a serial hash-table encoder is the right tool —
-            # fall back so ratio matches the CPU scheme instead of
-            # emitting from an arbitrary record subset.
+            # fall back to it (ratio = CPU scheme's, within the segmented
+            # path's junction-window loss) instead of emitting from an
+            # arbitrary record subset.
             _M_FLOOD.incr("native_fallbacks")
             with self._lock:
                 self._flood_streak += 1
                 if self._flood_streak >= self.BYPASS_AFTER:
                     self._bypass_left = self.BYPASS_RUN
-            return bytes(native.lz4_compress(job.host))
+            return _lz4_compress_parallel(job.host)
         with self._lock:
             self._flood_streak = 0
         m = g < max(job.n - 12, 0)    # spec MFLIMIT; drops pad-region hits
-        out = native.lz4_emit(job.host, g[m], r[m])
+        g, r = g[m], r[m]
+        out = native.lz4_emit(job.host, g, r)
         if total > (job.n // self.stride) >> 10:
             # Grey zone (non-trivial record density below the flood cap):
             # the sorted matcher can trail the serial encoder by a few
-            # percent here — run the native encoder too and keep the
-            # smaller stream, so the TPU path's ratio is >= the CPU
-            # scheme's BY CONSTRUCTION on every container.  Sparse
-            # containers (incompressible) skip this: both encoders
-            # degenerate to the raw payload anyway.
-            alt = native.lz4_compress(job.host)
-            if len(alt) and len(alt) < len(out):
-                _M_FLOOD.incr("native_wins")
-                out = alt
+            # percent here — race the native encoder and keep the smaller
+            # stream.  The full-container race costs a whole native
+            # compress per grey container (~0.3 s at 32 MiB — measured as
+            # the second-largest TPU-path host cost on the mixed corpus),
+            # so first DECIDE on a sample: both encoders compress the same
+            # mid-container span, and only when the emit does not clearly
+            # win there does the full race run.  The decision errs toward
+            # racing (skip only on a >=2% sample win), so the kept stream
+            # is the smaller one wherever the outcome is close.
+            if self._sample_says_emit_wins(job, g, r, len(out)):
+                _M_FLOOD.incr("races_skipped")
+            else:
+                alt = _lz4_compress_parallel(job.host)
+                if len(alt) and len(alt) < len(out):
+                    _M_FLOOD.incr("native_wins")
+                    out = alt
         return out
 
-    def finish(self, job: Lz4Job) -> bytes:
+    _RACE_SAMPLE = 4 << 20
+
+    def _sample_says_emit_wins(self, job: Lz4Job, g: np.ndarray,
+                               r: np.ndarray, out_len: int) -> bool:
+        """True when the device-records emit beats the serial encoder by
+        >=2% on a mid-container sample span (same bytes, same records,
+        rebased) — the containers where racing the full native encoder
+        would only reproduce a larger stream."""
         from hdrf_tpu import native
 
+        n = job.n
+        if n < 3 * self._RACE_SAMPLE or out_len >= n:
+            return False  # small container or emit >= raw: race cheaply/properly
+        lo = (n // 2) & ~65535
+        lo0 = max(lo - 65536, 0)   # back-window so sampled offsets verify
+        hi = min(lo + self._RACE_SAMPLE, n)
+        sl = job.host[lo0:hi]
+        m = (g >= lo0) & (g < hi - 12)
+        es = native.lz4_emit(sl, g[m] - lo0, r[m])
+        ns = native.lz4_compress(sl)
+        return len(es) * 100 <= len(ns) * 98
+
+    def finish(self, job: Lz4Job) -> bytes:
         if job.recs is None:
-            return native.lz4_compress(job.host) if job.n else b""
+            return (_lz4_compress_parallel(job.host)
+                    if job.n else b"")
         out = self._assemble(job, np.asarray(job.recs))
         job.block = None
         job.recs = None
