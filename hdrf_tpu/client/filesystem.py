@@ -54,6 +54,7 @@ class HdrfClient:
         addrs = normalize_addrs(namenode_addr)
         self._nn = (HaRpcClient(addrs) if len(addrs) > 1
                     else RpcClient(addrs[0]))
+        self._sc_cache = None  # lazy ShortCircuitCache (fd + shm slots)
         self._dtoken: dict | None = None
         if self.config.use_delegation_tokens:
             self._dtoken = self._nn.call("get_delegation_token",
@@ -108,6 +109,9 @@ class HdrfClient:
         return out
 
     def close(self) -> None:
+        if self._sc_cache is not None:
+            self._sc_cache.close()
+            self._sc_cache = None
         self._nn.close()
 
     def __enter__(self) -> "HdrfClient":
@@ -433,7 +437,8 @@ class HdrfClient:
                                     self.config.encrypt_data_transfer)
             dt.send_op(sock, dt.WRITE_BLOCK, block_id=alloc["block_id"],
                        gen_stamp=alloc["gen_stamp"], scheme=alloc["scheme"],
-                       token=alloc.get("token"), targets=targets[1:])
+                       token=alloc.get("token"), targets=targets[1:],
+                       storage_type=targets[0].get("storage_type"))
             npkts = dt.stream_bytes(sock, block, self.config.packet_size)
             # Drain per-packet acks; the final one carries pipeline status.
             status = dt.ACK_SUCCESS
@@ -495,15 +500,21 @@ class HdrfClient:
         if not locations:
             raise IOError(f"block {binfo['block_id']} has no live locations")
         # Short-circuit: a co-located DN passes the replica fd over its unix
-        # socket and we pread directly (ShortCircuitCache.java:72 analog).
+        # socket and we pread directly.  Granted fds are CACHED across
+        # reads (ShortCircuitCache.java:72), each guarded by a DN-owned
+        # shm slot: delete/append revokes the slot and the next read
+        # re-fetches instead of serving stale bytes.
         if self.config.short_circuit:
-            from hdrf_tpu.server.shortcircuit import read_local
+            if self._sc_cache is None:
+                from hdrf_tpu.server.shortcircuit import ShortCircuitCache
 
+                self._sc_cache = ShortCircuitCache()
             for loc in locations:
                 sc = loc.get("sc_path")
                 if sc and loc["addr"][0] in ("127.0.0.1", "localhost"):
-                    data = read_local(sc, binfo["block_id"], offset, length,
-                                      token=binfo.get("token"))
+                    data = self._sc_cache.read(sc, binfo["block_id"], offset,
+                                               length,
+                                               token=binfo.get("token"))
                     if data is not None:
                         _M.incr("short_circuit_reads")
                         return data

@@ -146,10 +146,14 @@ class DataNodeConfig:
     data_dir: str = "/tmp/hdrf/data"
     # Topology label for rack-aware placement (net.topology mapping analog).
     rack: str = "/default-rack"
-    # This DN's storage type (StorageType enum analog: DISK/SSD/ARCHIVE/
-    # RAM_DISK).  One volume per DN by design (PARITY.md), so the type is
-    # per-node; storage POLICIES on paths select across nodes.
+    # This DN's default storage type (StorageType enum analog: DISK/SSD/
+    # ARCHIVE/RAM_DISK); storage POLICIES on paths select across nodes
+    # and, with multiple volumes, across a node's volumes.
     storage_type: str = "DISK"
+    # Per-volume storage types (dfs.datanode.data.dir's [SSD]/path list
+    # analog): each entry creates volumes/vol-i of that type under
+    # data_dir.  None = one volume of ``storage_type``.
+    volume_types: list | None = None
     # Packet size on the data-transfer wire (reference default 64 KB).
     packet_size: int = 64 * 1024
     # Pinned replica cache budget (dfs.datanode.max.locked.memory analog).

@@ -87,13 +87,19 @@ class BlockReceiver:
         targets = fields.get("targets", [])
         mirror_sock = None
         with dn.direct_slot():  # bounded concurrent streaming writes
-            writer = dn.replicas.create_rbw(block_id, gen_stamp)
+            writer = dn.replicas.create_rbw(
+                block_id, gen_stamp,
+                storage_type=fields.get("storage_type"))
             try:
                 if targets:
                     mirror_sock = _connect(targets[0]["addr"], dn, block_id,
                                            fields.get("token"))
+                    # each hop rewrites the routing hint to ITS target's
+                    # slot type (the NN annotates every target)
                     dt.send_op(mirror_sock, dt.WRITE_BLOCK,
-                               **{**fields, "targets": targets[1:]})
+                               **{**fields, "targets": targets[1:],
+                                  "storage_type":
+                                  targets[0].get("storage_type")})
                 crcs: list[int] = []
                 tail = b""
                 cchunk = dn.checksum_chunk

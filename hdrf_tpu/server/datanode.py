@@ -40,8 +40,6 @@ from hdrf_tpu.reduction import scheme as schemes
 from hdrf_tpu.reduction.scheme import ReductionContext, ReductionScheme
 from hdrf_tpu.server.block_receiver import BlockReceiver
 from hdrf_tpu.server.block_sender import BlockSender
-from hdrf_tpu.storage.container_store import ContainerStore
-from hdrf_tpu.storage.replica_store import ReplicaStore
 from hdrf_tpu.utils import fault_injection, metrics
 
 _M = metrics.registry("datanode")
@@ -116,13 +114,6 @@ class DataNode:
 
         storage_version.ensure_layout(config.data_dir, "datanode",
                                       storage_version.DN_UPGRADERS)
-        vol0 = os.path.join(config.data_dir, "volumes", "vol-0")
-        if config.simulated_dataset:
-            from hdrf_tpu.storage.simulated import SimulatedReplicaStore
-
-            self.replicas = SimulatedReplicaStore()
-        else:
-            self.replicas = ReplicaStore(os.path.join(vol0, "replicas"))
         backend = ops_dispatch.resolve_backend(red.backend)
         # Seal entropy stage (the reference's rollover LZ4,
         # DataDeduplicator.java:770-781), most-capable-first: the
@@ -151,10 +142,26 @@ class DataNode:
         elif backend == "tpu" and red.container_codec == "lz4":
             seal_fn = (lambda data:
                        ops_dispatch.block_compress("lz4", data, "tpu"))
-        self.containers = ContainerStore(
-            os.path.join(vol0, "containers"),
-            container_size=red.container_size, codec=red.container_codec,
-            compress_fn=seal_fn, fsync=red.fsync_containers)
+        # Volumes (FsVolumeList analog): one ReplicaStore + ContainerStore
+        # per configured volume type, replica/chunk placement across them,
+        # per-volume failure ejection (storage/volumes.py).
+        from hdrf_tpu.storage.volumes import VolumeSet
+
+        self.volume_types = list(config.volume_types
+                                 or [config.storage_type])
+        self.volumes = VolumeSet(
+            config.data_dir, self.volume_types,
+            container_kw=dict(container_size=red.container_size,
+                              codec=red.container_codec,
+                              compress_fn=seal_fn,
+                              fsync=red.fsync_containers))
+        if config.simulated_dataset:
+            from hdrf_tpu.storage.simulated import SimulatedReplicaStore
+
+            self.replicas = SimulatedReplicaStore()
+        else:
+            self.replicas = self.volumes
+        self.containers = self.volumes.containers
         self.index = ChunkIndex(os.path.join(config.data_dir, "index"))
         recon = None
         if red.device_recon and backend == "tpu" and self._worker is None:
@@ -244,6 +251,7 @@ class DataNode:
     def stop(self) -> None:
         self._stop.set()
         self._sc.stop()
+        self._sc.stop_registry()
         self._server.shutdown()
         self._server.server_close()
         self._sever_connections()
@@ -308,6 +316,9 @@ class DataNode:
         # copy-on-append rewrites the same block id, and serving the stale
         # pinned bytes would lose the appended region
         self.cache.unpin(block_id)
+        # ... and revokes outstanding short-circuit grants for the same
+        # reason (a cached client fd still maps the superseded inode)
+        self._sc.registry.revoke(block_id)
         self._ibr_queue.append((block_id, length, gen_stamp))
         self._ibr_event.set()
 
@@ -375,6 +386,19 @@ class DataNode:
                                   "gen_stamp": meta.gen_stamp if meta else -1,
                                   "rbw": self.replicas.is_rbw(
                                       fields["block_id"])})
+            elif op == "disk_balance":
+                # intra-DN volume evening (diskbalancer -plan/-execute in
+                # one round trip; like the DN protocol, trusted within the
+                # deployment perimeter rather than block-token gated)
+                plan = self.volumes.plan_moves(
+                    float(fields.get("threshold", 0.10)))
+                moved = self.volumes.execute_moves(plan)
+                send_frame(sock, {
+                    "planned": len(plan), "moved": moved,
+                    "volumes": [{"vol": v.vol_id, "type": v.storage_type,
+                                 "used": v.used_bytes(),
+                                 "failed": v.failed}
+                                for v in self.volumes.volumes]})
             elif op == "truncate_replica":
                 self.tokens.verify(fields.get("token"), fields["block_id"], "w")
                 ok = self.replicas.truncate_replica(
@@ -415,7 +439,8 @@ class DataNode:
                 resp = c.call("register_datanode", dn_id=self.dn_id,
                               addr=list(self.addr), sc_path=self._sc.path,
                               rack=self.config.rack,
-                              storage_type=self.config.storage_type)
+                              storage_type=self.volume_types[0],
+                              storage_types=self.volume_types)
                 if resp.get("block_keys"):
                     self.tokens.update_keys(resp["block_keys"])
                 self._send_block_report(c)
@@ -641,6 +666,7 @@ class DataNode:
 
     def _invalidate(self, block_id: int) -> None:
         self.cache.unpin(block_id)
+        self._sc.registry.revoke(block_id)  # cached client fds must drop
         meta = self.replicas.get_meta(block_id)
         if meta is None:
             return
@@ -713,10 +739,10 @@ class DataNode:
 
     # ---------------------------------------------------------- volume health
 
-    def check_volume(self) -> bool:
-        """One write+read+unlink probe of the data dir (DatasetVolumeChecker's
-        disk check).  True = healthy."""
-        probe = os.path.join(self.config.data_dir, ".probe")
+    def check_volume(self, root: str | None = None) -> bool:
+        """One write+read+unlink probe of a volume root (the
+        DatasetVolumeChecker disk check).  True = healthy."""
+        probe = os.path.join(root or self.config.data_dir, ".probe")
         try:
             with open(probe, "wb") as f:
                 f.write(b"hdrf-volume-probe")
@@ -729,20 +755,37 @@ class DataNode:
         except OSError:
             return False
 
+    def eject_volume(self, vol_id: int) -> None:
+        """Volume failure (DataNode.handleVolumeFailures): drop the volume,
+        push an immediate block report so the NN learns the lost replicas
+        NOW (not at the next periodic report) and re-replicates."""
+        lost = self.volumes.eject(vol_id)
+        if lost:
+            try:
+                self._send_block_report()
+            except (OSError, ConnectionError):
+                pass  # periodic report will carry it
+
     def _volume_check_loop(self) -> None:
         """Async disk health (DatasetVolumeChecker + ThrottledAsyncChecker
-        analog).  This DN has one volume, so the reference's eject-bad-volume
-        action becomes shut-down-the-DN (HDFS DNs exit when every volume has
-        failed); the NN's dead-node path re-replicates from peers."""
-        failures = 0
+        analog), per volume: a volume failing 3 consecutive probes is
+        EJECTED (blocks re-replicate from peers, the DN keeps serving the
+        rest); the DN exits only when the last volume has failed — the
+        reference's failed.volumes.tolerated behavior."""
+        fails = {v.vol_id: 0 for v in self.volumes.volumes}
         while not self._stop.wait(self.config.volume_check_interval_s):
-            if self.check_volume():
-                failures = 0
-                _M.incr("volume_checks_ok")
-                continue
-            failures += 1
-            _M.incr("volume_checks_failed")
-            if failures >= 3:
+            for v in self.volumes.volumes:
+                if v.failed:
+                    continue
+                if self.check_volume(v.root):
+                    fails[v.vol_id] = 0
+                    _M.incr("volume_checks_ok")
+                    continue
+                fails[v.vol_id] += 1
+                _M.incr("volume_checks_failed")
+                if fails[v.vol_id] >= 3:
+                    self.eject_volume(v.vol_id)
+            if self.volumes.alive_count() == 0:
                 _M.incr("volume_failures_fatal")
                 threading.Thread(target=self.stop, daemon=True).start()
                 return

@@ -72,6 +72,9 @@ class BlockInfo:
     # that is the block's only copy must never be destroyed (the reference's
     # commitBlockSynchronization restamps it instead).
     reported: dict[str, tuple[int, int]] = field(default_factory=dict)
+    # per-replica storage type from reports (DatanodeStorageInfo analog):
+    # dn_id -> "DISK"/"SSD"/...; absent for DNs that report untyped
+    storage_of: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -109,7 +112,10 @@ class DatanodeInfo:
     stats: dict = field(default_factory=dict)
     sc_path: str | None = None  # short-circuit unix socket (co-located reads)
     rack: str = "/default-rack"
-    storage_type: str = "DISK"  # StorageType analog (DISK/SSD/ARCHIVE/...)
+    storage_type: str = "DISK"  # primary StorageType (first volume's)
+    # every type this DN has a volume of (multi-volume DNs report a list;
+    # the reference models this as one DatanodeStorageInfo per storage)
+    storage_types: tuple = ("DISK",)
     cached: set[int] = field(default_factory=set)  # pinned block ids
 
 
@@ -1300,8 +1306,10 @@ class NameNode:
             self._leases.check(path, client)
             self._check_space_quota(path, self.config.block_size)
             bid, gs = self._next_block_id, self._gen_stamp
+            slots: list = []
             targets = self._choose_targets(node.replication, exclude=set(),
-                                           policy=self._policy_of(path))
+                                           policy=self._policy_of(path),
+                                           slots=slots)
             if not targets:
                 raise IOError("no datanodes available")
             self._log(["add_block", path, bid, gs])
@@ -1311,8 +1319,9 @@ class NameNode:
             return {"block_id": bid, "gen_stamp": gs, "scheme": node.scheme,
                     "token": (self._tokens.mint(bid, "w")
                               if self._tokens else None),
-                    "targets": [{"dn_id": d.dn_id, "addr": list(d.addr)}
-                                for d in targets]}
+                    "targets": [{"dn_id": d.dn_id, "addr": list(d.addr),
+                                 "storage_type": st}
+                                for d, st in zip(targets, slots)]}
 
     def rpc_add_block_group(self, path: str, client: str) -> dict:
         """Allocate one EC block group: k+m internal blocks on k+m distinct
@@ -1893,7 +1902,9 @@ class NameNode:
                 need = list(want)
                 wrong = []
                 for d in locs:
-                    t = live_dns[d].storage_type
+                    # the replica's ACTUAL volume type when the DN reports
+                    # per-storage; the node's primary type otherwise
+                    t = info.storage_of.get(d, live_dns[d].storage_type)
                     if t in need:
                         need.remove(t)
                     else:
@@ -1901,7 +1912,7 @@ class NameNode:
                 if not need:
                     continue
                 cands = [d for d in live_dns.values()
-                         if d.storage_type == need[0]
+                         if need[0] in d.storage_types
                          and d.dn_id not in info.locations
                          and d.dn_id not in self._decommissioning]
                 if wrong and cands:
@@ -2183,11 +2194,13 @@ class NameNode:
     def rpc_register_datanode(self, dn_id: str, addr: list,
                               sc_path: str | None = None,
                               rack: str = "/default-rack",
-                              storage_type: str = "DISK") -> dict:
+                              storage_type: str = "DISK",
+                              storage_types: list | None = None) -> dict:
         with self._lock:
             self._datanodes[dn_id] = DatanodeInfo(
                 dn_id, (addr[0], addr[1]), last_heartbeat=time.monotonic(),
-                sc_path=sc_path, rack=rack, storage_type=storage_type)
+                sc_path=sc_path, rack=rack, storage_type=storage_type,
+                storage_types=tuple(storage_types or [storage_type]))
             _M.incr("dn_registered")
             keys = None
             if self._tokens is not None:
@@ -2227,8 +2240,15 @@ class NameNode:
             if dn is None:
                 raise KeyError(f"unregistered datanode {dn_id}")
             reported = set()
-            for bid, gs, length in blocks:
+            for row in blocks:
+                # rows are (bid, gs, len) or (bid, gs, len, storage_type) —
+                # multi-volume DNs report each replica's volume type
+                # (per-storage reports, DatanodeStorageInfo analog)
+                bid, gs, length = row[0], row[1], row[2]
+                stype = row[3] if len(row) > 3 else None
                 info = self._blocks.get(bid)
+                if stype is not None and info is not None:
+                    info.storage_of[dn_id] = stype
                 if info is None:
                     # replica for a deleted file: drop it (only the active
                     # may command — a lagging standby would invalidate
@@ -2615,12 +2635,18 @@ class NameNode:
         return [pref[min(i, len(pref) - 1)] for i in range(n)]
 
     def _choose_targets(self, n: int, exclude: set[str],
-                        policy: str | None = None) -> list[DatanodeInfo]:
+                        policy: str | None = None,
+                        slots: list | None = None) -> list[DatanodeInfo]:
         """Rack- and storage-policy-aware placement
         (BlockPlacementPolicyDefault-lite): per replica index the policy's
         preferred storage type is satisfied first, falling back to any
         live node; within a type class, round-robin across racks so
-        replicas spread over failure domains before doubling up."""
+        replicas spread over failure domains before doubling up.  A
+        multi-volume DN matches a type class if ANY of its volumes has
+        that type.  ``slots`` (out-param) receives the storage type each
+        chosen target was picked FOR, aligned with the returned list —
+        the hint the write op carries so the receiving DN routes the
+        replica to a matching volume."""
         now = time.monotonic()
         live = [d for d in self._datanodes.values()
                 if now - d.last_heartbeat < self.config.dead_node_interval_s
@@ -2645,14 +2671,21 @@ class NameNode:
                         k -= 1
 
         out: list[DatanodeInfo] = []
+        slot_of: dict[str, str] = {}
         # policy pass: fill each type class from matching nodes
         from collections import Counter
 
         for stype, count in Counter(wanted_types).items():
-            pick([d for d in live if d.storage_type == stype], count, out)
+            before = len(out)
+            pick([d for d in live if stype in d.storage_types], count, out)
+            for d in out[before:]:
+                slot_of[d.dn_id] = stype
         if len(out) < n:  # fallback chain: any live node
             pick(live, n - len(out), out)
-        return out[:n]
+        out = out[:n]
+        if slots is not None:
+            slots.extend(slot_of.get(d.dn_id, d.storage_type) for d in out)
+        return out
 
     # -------------------------------------------------------------------- HA
 

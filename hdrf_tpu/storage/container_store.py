@@ -55,7 +55,8 @@ class ContainerStore:
 
     def __init__(self, directory: str, container_size: int = 1 << 25,
                  lanes: int = 4, codec: str = "lz4", cache_containers: int = 4,
-                 compress_fn=None, on_roll=None, fsync: bool = False):
+                 compress_fn=None, on_roll=None, fsync: bool = False,
+                 id_base: int = 0):
         """``compress_fn`` overrides the seal-time compressor while keeping
         the frame codec id (the TPU LZ4 stage produces format-identical
         output, so readers decode with the stock codec either way).
@@ -78,7 +79,13 @@ class ContainerStore:
         # reconstructor drop its stale HBM image
         self._on_delete = None
         self._alloc_lock = threading.Lock()
-        self._next_id = self._scan_next_id()
+        # ``id_base`` namespaces this store's container ids (multi-volume
+        # DNs: vol_id << CID_SHIFT — the same trick the reference uses to
+        # namespace container ids by writer thread, the 2-bit threadID
+        # field packed into its 3-byte ids at utilities.java:36-75), so
+        # one DN-wide chunk index can route any cid to its volume.
+        self._id_base = id_base
+        self._next_id = max(self._scan_next_id(), id_base)
         self._lanes = [_Lane(threading.Lock()) for _ in range(lanes)]
         self._rr = 0
         # Tiny LRU of decompressed sealed containers (read amplification guard;

@@ -147,7 +147,10 @@ class ReplicaStore:
 
     # -------------------------------------------------------------- lifecycle
 
-    def create_rbw(self, block_id: int, gen_stamp: int = 0) -> ReplicaWriter:
+    def create_rbw(self, block_id: int, gen_stamp: int = 0,
+                   storage_type: str | None = None) -> ReplicaWriter:
+        # ``storage_type`` is a volume-routing hint consumed by VolumeSet
+        # (storage/volumes.py); a single store has nowhere to route.
         with self._lock:
             existing = self._replicas.get(block_id)
             if existing is not None and gen_stamp <= existing.gen_stamp:
@@ -255,6 +258,24 @@ class ReplicaStore:
                 os.fsync(f.fileno())
             os.replace(mp + ".tmp", mp)  # write-replace: see above
             return True
+
+    def adopt(self, meta: BlockMeta, data: bytes) -> None:
+        """Install a finalized replica wholesale (intra-DN volume move,
+        DiskBalancer's movePhysicalBlock analog): data + meta land under
+        finalized/ via write-then-rename, then register."""
+        dst = self._path(FINALIZED, meta.block_id)
+        with open(dst + ".tmp", "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(dst + ".tmp", dst)
+        with open(dst + ".meta.tmp", "wb") as f:
+            f.write(meta.pack())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(dst + ".meta.tmp", dst + ".meta")
+        self._register(meta)
+        _M.incr("replicas_adopted")
 
     def delete(self, block_id: int) -> None:
         with self._lock:

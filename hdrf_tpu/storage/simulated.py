@@ -61,7 +61,8 @@ class SimulatedReplicaStore:
         self._meta: dict[int, BlockMeta] = {}
         self._rbw: set[int] = set()
 
-    def create_rbw(self, block_id: int, gen_stamp: int = 0) -> SimulatedWriter:
+    def create_rbw(self, block_id: int, gen_stamp: int = 0,
+                   storage_type: str | None = None) -> SimulatedWriter:
         with self._lock:
             # same contract as the real store: finalized OR in-flight
             # duplicates are rejected

@@ -27,6 +27,7 @@ class MiniCluster:
                  dead_node_s: float = 1.5, ha: bool = False,
                  journal_nodes: int = 0, secure: bool = False,
                  storage_types: list[str] | None = None,
+                 volume_types: list[str] | None = None,
                  tpu_worker: bool = False):
         """``journal_nodes`` > 0 boots that many JournalNodes and puts the
         edit log on the quorum (MiniQJMHACluster analog); each NN then gets
@@ -43,6 +44,8 @@ class MiniCluster:
         self.n_journal = journal_nodes
         self.secure = secure
         self.storage_types = storage_types or []
+        # per-DN volume types (multi-volume DNs); applies to EVERY DN
+        self.volume_types = volume_types
         self.tpu_worker = tpu_worker
         self._worker_proc = None
         self._worker_addr = None
@@ -126,6 +129,8 @@ class MiniCluster:
         cfg.encrypt_data_transfer = self.secure
         if i < len(self.storage_types):
             cfg.storage_type = self.storage_types[i]
+        if self.volume_types is not None:
+            cfg.volume_types = list(self.volume_types)
         return DataNode(cfg, self.nn_addrs(), dn_id=f"dn-{i}")
 
     def stop(self) -> None:

@@ -374,6 +374,22 @@ def cmd_balancer(args) -> int:
     return 0
 
 
+def cmd_diskbalancer(args) -> int:
+    """DiskBalancer-lite (server/diskbalancer analog): ask a DataNode to
+    even its own volumes — plan + execute in one round trip."""
+    import socket as _socket
+
+    from hdrf_tpu.proto import datatransfer as dt
+    from hdrf_tpu.proto.rpc import recv_frame
+
+    host, port = args.datanode.rsplit(":", 1)
+    with _socket.create_connection((host, int(port)), timeout=60) as s:
+        dt.send_op(s, "disk_balance", threshold=args.threshold)
+        r = recv_frame(s)
+    print(json.dumps(r, indent=2))
+    return 0
+
+
 def cmd_mover(args) -> int:
     """Mover (server/mover/Mover.java:70 analog): migrate replicas until
     every block's storage types satisfy its path's effective policy.  The
@@ -431,6 +447,11 @@ def main(argv: list[str] | None = None) -> int:
     d.add_argument("--namenode", required=True)
     d.add_argument("--secure", action="store_true")
     d.set_defaults(fn=cmd_dfsadmin, takes_ops=True)
+
+    d = sub.add_parser("diskbalancer")
+    d.add_argument("--datanode", required=True, help="host:port")
+    d.add_argument("--threshold", type=float, default=0.10)
+    d.set_defaults(fn=cmd_diskbalancer)
 
     d = sub.add_parser("storage")
     d.add_argument("action", choices=["version", "rollback", "finalize"])
