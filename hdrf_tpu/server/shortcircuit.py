@@ -1,10 +1,11 @@
-"""Short-circuit local reads: Unix-domain fd passing.
+"""Short-circuit local reads: Unix-domain fd passing + shm slot revocation.
 
 Re-expression of the reference's short-circuit stack — client
-`hdfs/shortcircuit/ShortCircuitCache.java:72` + DN `ShortCircuitRegistry`
-(REQUEST_SHORT_CIRCUIT_FDS op over a DomainSocket, fd passed with
-SCM_RIGHTS, libhadoop JNI underneath) — in ~100 lines, because Python's
-``socket.send_fds`` wraps the same kernel facility directly.
+`hdfs/shortcircuit/ShortCircuitCache.java:72` + DN
+`ShortCircuitRegistry.java:83` with `ShortCircuitShm` (REQUEST_SHORT_CIRCUIT_FDS
+over a DomainSocket, fd passed with SCM_RIGHTS, a shared-memory segment of
+per-replica slots the DN flips to revoke) — because Python's
+``socket.send_fds`` and ``mmap`` wrap the same kernel facilities directly.
 
 The DataNode listens on ``<data_dir>/sc.sock``.  A local client asks for a
 block's fds; the DN replies with the replica metadata (scheme, lengths,
@@ -12,12 +13,21 @@ checksums) and, when the replica has a physical data file whose bytes ARE the
 logical bytes (direct scheme), the open file descriptor.  Reduced replicas
 (dedup/compress) answer metadata-only and the client falls back to the TCP
 read path — reconstruction must run on the DN where the chunk store lives.
-"""
+
+Revocation (the registry half the fd pass alone lacks): a client may CACHE
+granted fds (``ShortCircuitCache``); a cached fd can outlive the replica
+(delete) or serve stale bytes (append supersede).  So each grant carries a
+SLOT in a shared-memory segment the client obtained from the DN (one shm
+fd-passed per client connection set, slots byte-sized); the DN's registry
+flips the slot to 0 when the replica is invalidated or superseded, and the
+client checks its slot BEFORE every cached-fd read — invalid means drop the
+fd and re-request (falling back to TCP when the block is gone)."""
 
 from __future__ import annotations
 
 import array
 import json
+import mmap
 import os
 import socket
 import threading
@@ -30,6 +40,105 @@ if TYPE_CHECKING:
 
 _M = metrics.registry("shortcircuit")
 MAX_REQ = 4096
+SHM_SLOTS = 4096
+
+
+class ShortCircuitRegistry:
+    """DN-side grant registry (ShortCircuitRegistry.java:83 analog): shm
+    segments per client, slot allocation per granted fd, revocation by
+    slot write."""
+
+    def __init__(self, directory: str):
+        self._dir = directory
+        self._lock = threading.Lock()
+        self._next_shm = 0
+        self._shms: dict[int, mmap.mmap] = {}
+        self._free: dict[int, list[int]] = {}
+        # per-slot generation: a recycled slot gets a NEW generation, so a
+        # client still holding the old grant fails its gen compare instead
+        # of being re-validated by an unrelated grant (the ABA hazard)
+        self._gen: dict[tuple[int, int], int] = {}
+        # block_id -> [(shm_id, slot)] of outstanding grants
+        self._grants: dict[int, list[tuple[int, int]]] = {}
+
+    def alloc_shm(self) -> tuple[int, int]:
+        """Create a slot segment; returns (shm_id, fd).  The fd is passed
+        to the client (both sides mmap the same file); the backing file is
+        unlinked immediately — it lives as long as the fds/mmaps do.  The
+        caller must arrange ``free_shm`` when the owning client goes away
+        (the server ties it to the alloc connection's lifetime — the
+        DomainSocketWatcher role)."""
+        with self._lock:
+            shm_id = self._next_shm
+            self._next_shm += 1
+        path = os.path.join(self._dir, f".scshm-{shm_id}")
+        fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        os.ftruncate(fd, SHM_SLOTS)
+        os.unlink(path)
+        mm = mmap.mmap(fd, SHM_SLOTS)
+        with self._lock:
+            self._shms[shm_id] = mm
+            self._free[shm_id] = list(range(SHM_SLOTS - 1, -1, -1))
+        _M.incr("shms_allocated")
+        return shm_id, fd
+
+    def free_shm(self, shm_id: int) -> None:
+        """Client went away: release its segment and every grant in it."""
+        with self._lock:
+            mm = self._shms.pop(shm_id, None)
+            self._free.pop(shm_id, None)
+            for bid in list(self._grants):
+                kept = [(s, sl) for s, sl in self._grants[bid]
+                        if s != shm_id]
+                if kept:
+                    self._grants[bid] = kept
+                else:
+                    del self._grants[bid]
+            for key in [k for k in self._gen if k[0] == shm_id]:
+                del self._gen[key]
+            if mm is not None:
+                mm.close()
+                _M.incr("shms_freed")
+
+    def grant(self, shm_id: int, block_id: int) -> tuple[int, int] | None:
+        """Allocate + validate a slot for a granted fd; returns
+        (slot, generation) or None when the shm is unknown or full (the
+        client must then use the fd single-shot, uncached)."""
+        with self._lock:
+            mm = self._shms.get(shm_id)
+            free = self._free.get(shm_id)
+            if mm is None or not free:
+                return None
+            slot = free.pop()
+            key = (shm_id, slot)
+            gen = self._gen.get(key, 0) % 255 + 1   # 1..255, never 0
+            self._gen[key] = gen
+            mm[slot] = gen
+            self._grants.setdefault(block_id, []).append(key)
+            _M.incr("slots_granted")
+            return slot, gen
+
+    def revoke(self, block_id: int) -> int:
+        """Replica deleted or superseded: invalidate every outstanding
+        grant's slot so cached fds are dropped before the next read."""
+        with self._lock:
+            grants = self._grants.pop(block_id, [])
+            for shm_id, slot in grants:
+                mm = self._shms.get(shm_id)
+                if mm is not None:
+                    mm[slot] = 0
+                    self._free[shm_id].append(slot)
+            if grants:
+                _M.incr("slots_revoked", len(grants))
+            return len(grants)
+
+    def close(self) -> None:
+        with self._lock:
+            for mm in self._shms.values():
+                mm.close()
+            self._shms.clear()
+            self._grants.clear()
+            self._gen.clear()
 
 
 def _entok(token: dict | None) -> dict | None:
@@ -63,6 +172,8 @@ class ShortCircuitServer:
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.bind(sock_path)
         self._sock.listen(16)
+        self.registry = ShortCircuitRegistry(os.path.dirname(sock_path)
+                                             or ".")
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._serve,
                                         name="dn-shortcircuit", daemon=True)
@@ -90,9 +201,34 @@ class ShortCircuitServer:
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 
+    def stop_registry(self) -> None:
+        self.registry.close()
+
     def _handle(self, conn: socket.socket) -> None:
         try:
             req = json.loads(conn.recv(MAX_REQ).decode())
+            if req.get("op") == "alloc_shm":
+                # hand the client its slot segment (ShortCircuitShm): the
+                # fd rides the ancillary data, the id routes future
+                # grants.  The connection then STAYS OPEN as the client's
+                # liveness channel (DomainSocketWatcher role): EOF means
+                # the client is gone and its segment + grants are freed.
+                shm_id, fd = self.registry.alloc_shm()
+                payload = json.dumps({"status": "ok",
+                                      "shm_id": shm_id}).encode()
+                prefix = len(payload).to_bytes(4, "little")
+                try:
+                    socket.send_fds(conn, [prefix], [fd])
+                finally:
+                    os.close(fd)
+                conn.sendall(payload)
+                try:
+                    while conn.recv(1):
+                        pass   # client never writes; EOF = disconnect
+                except OSError:
+                    pass
+                self.registry.free_shm(shm_id)
+                return
             block_id = req["block_id"]
             # Same gate as the TCP read path: when block tokens are enabled,
             # REQUEST_SHORT_CIRCUIT_FDS requires a READ token (the reference
@@ -116,6 +252,12 @@ class ShortCircuitServer:
                     "checksum_chunk": meta.checksum_chunk,
                     "checksums": meta.checksums,
                     "fd": meta.scheme == "direct" and meta.physical_len > 0}
+            if resp["fd"] and "shm_id" in req:
+                # revocable grant: the slot index + generation the client
+                # must check before every cached-fd read
+                g = self.registry.grant(int(req["shm_id"]), block_id)
+                if g is not None:
+                    resp["slot"], resp["slot_gen"] = g
             # Length-prefixed reply: checksum lists for large blocks run to
             # tens of KB, far past any single recv.  The fd rides the
             # ancillary data of the 4-byte prefix send.
@@ -139,41 +281,154 @@ class ShortCircuitServer:
             conn.close()
 
 
-def read_local(sock_path: str, block_id: int, offset: int,
-               length: int, token: dict | None = None) -> bytes | None:
-    """Client side: fetch the replica fd over the unix socket and pread the
-    range directly — zero copies through the DN process.  Returns None when
-    short-circuit isn't possible (reduced replica, dead socket, remote DN,
-    missing/invalid block token)."""
+def _request(sock_path: str, req: dict,
+             keep_conn: bool = False
+             ) -> tuple[dict | None, list[int], socket.socket | None]:
+    """One round trip on the unix socket; returns (response, passed fds,
+    connection).  The caller owns any returned fds; the connection is
+    returned open only with ``keep_conn`` (the shm liveness channel),
+    otherwise closed."""
     try:
         conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         conn.settimeout(10)
         conn.connect(sock_path)
     except OSError:
-        return None
+        return None, [], None
     fds: list[int] = []
     try:
-        conn.sendall(json.dumps({"block_id": block_id,
-                                 "token": _entok(token)}).encode())
+        conn.sendall(json.dumps(req).encode())
         prefix, fds, _, _ = socket.recv_fds(conn, 4, 1)
+        fds = list(fds)
         while len(prefix) < 4:
             more = conn.recv(4 - len(prefix))
             if not more:
-                return None
+                raise OSError("short prefix")
             prefix += more
         want = int.from_bytes(prefix[:4], "little")
         buf = bytearray()
         while len(buf) < want:
             piece = conn.recv(want - len(buf))
             if not piece:
-                return None
+                raise OSError("short body")
             buf += piece
         resp = json.loads(bytes(buf).decode())
-        if resp.get("status") != "ok" or not resp.get("fd") or not fds:
+        if keep_conn:
+            return resp, fds, conn
+        conn.close()
+        return resp, fds, None
+    except (OSError, ValueError):
+        for fd in fds:
+            os.close(fd)
+        conn.close()
+        return None, [], None
+
+
+class ShortCircuitCache:
+    """Client-side fd cache (ShortCircuitCache.java:72 analog): granted
+    fds are kept and re-used across reads, each guarded by its shm slot —
+    the DN zeroes the slot when the replica is deleted/superseded, and the
+    next read drops the stale fd and re-requests instead of serving stale
+    bytes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # sock_path -> (shm mmap|None, shm_id|None, liveness conn|None)
+        self._shm: dict[str, tuple] = {}
+        # (sock_path, block_id) -> (fd, slot, slot_gen, resp meta); only
+        # slot-guarded grants are cached — an unguarded fd would be
+        # unrevocable and could serve stale bytes forever
+        self._fds: dict[tuple[str, int], tuple[int, int, int, dict]] = {}
+
+    def _shm_for(self, sock_path: str):
+        with self._lock:
+            if sock_path in self._shm:
+                return self._shm[sock_path]
+        # the connection stays OPEN: it is the DN's liveness signal for
+        # this segment (close() -> EOF -> DN frees the shm + grants)
+        resp, fds, conn = _request(sock_path, {"op": "alloc_shm"},
+                                   keep_conn=True)
+        mm = shm_id = None
+        if resp and resp.get("status") == "ok" and fds:
+            try:
+                mm = mmap.mmap(fds[0], SHM_SLOTS)
+                shm_id = resp["shm_id"]
+            except (OSError, ValueError):
+                mm = shm_id = None
+        for fd in fds:
+            os.close(fd)
+        if mm is None and conn is not None:
+            conn.close()
+            conn = None
+        with self._lock:
+            if sock_path in self._shm:   # lost a setup race: keep first
+                if conn is not None:
+                    conn.close()
+                if mm is not None:
+                    mm.close()
+            else:
+                self._shm[sock_path] = (mm, shm_id, conn)
+            return self._shm[sock_path]
+
+    def _drop(self, key: tuple[str, int]) -> None:
+        with self._lock:
+            ent = self._fds.pop(key, None)
+        if ent is not None:
+            os.close(ent[0])
+
+    def read(self, sock_path: str, block_id: int, offset: int,
+             length: int, token: dict | None = None) -> bytes | None:
+        key = (sock_path, block_id)
+        with self._lock:
+            ent = self._fds.get(key)
+        mm, shm_id, _conn = self._shm_for(sock_path)
+        if ent is not None:
+            fd, slot, gen, resp = ent
+            if mm is None or mm[slot] != gen:
+                # revoked (slot zeroed) or recycled to another grant (gen
+                # mismatch): either way this fd may map dead bytes
+                _M.incr("cached_fd_revoked")
+                self._drop(key)
+            else:
+                out = self._pread(fd, offset, length, resp)
+                if out is not None:
+                    _M.incr("cached_fd_reads")
+                    return out
+                self._drop(key)  # stale/corrupt: refetch below
+        req = {"block_id": block_id, "token": _entok(token)}
+        if shm_id is not None:
+            req["shm_id"] = shm_id
+        resp, fds, _ = _request(sock_path, req)
+        if not resp or resp.get("status") != "ok" or not resp.get("fd") \
+                or not fds:
+            for fd in fds:
+                os.close(fd)
             return None
+        fd = fds[0]
+        for extra in fds[1:]:
+            os.close(extra)
+        out = self._pread(fd, offset, length, resp)
+        slot, gen = resp.get("slot"), resp.get("slot_gen")
+        if out is None or slot is None or gen is None:
+            # no revocation guard (shm full/unavailable): single-use fd —
+            # caching it would make delete/append invisible to this client
+            os.close(fd)
+            return out
+        with self._lock:
+            old = self._fds.get(key)
+            self._fds[key] = (fd, slot, gen, resp)
+        if old is not None:
+            os.close(old[0])
+        return out
+
+    @staticmethod
+    def _pread(fd: int, offset: int, length: int,
+               resp: dict) -> bytes | None:
         end = resp["logical_len"] if length < 0 else min(
             offset + length, resp["logical_len"])
-        data = os.pread(fds[0], end - offset, offset)
+        try:
+            data = os.pread(fd, end - offset, offset)
+        except OSError:
+            return None
         if len(data) != end - offset:
             return None  # truncated replica: fall back, let the scanner act
         if not _verify(data, offset, resp):
@@ -182,12 +437,37 @@ def read_local(sock_path: str, block_id: int, offset: int,
         _M.incr("local_reads")
         _M.incr("local_bytes", len(data))
         return data
-    except (OSError, ValueError):
+
+    def close(self) -> None:
+        with self._lock:
+            for fd, _, _, _ in self._fds.values():
+                os.close(fd)
+            self._fds.clear()
+            for mm, _, conn in self._shm.values():
+                if conn is not None:
+                    conn.close()   # EOF -> DN frees the segment + grants
+                if mm is not None:
+                    mm.close()
+            self._shm.clear()
+
+
+def read_local(sock_path: str, block_id: int, offset: int,
+               length: int, token: dict | None = None) -> bytes | None:
+    """Uncached one-shot short-circuit read: fd fetched, pread, closed —
+    no shm allocation (a throwaway segment per call would grow the DN's
+    registry for nothing)."""
+    resp, fds, _ = _request(sock_path, {"block_id": block_id,
+                                        "token": _entok(token)})
+    if not resp or resp.get("status") != "ok" or not resp.get("fd") \
+            or not fds:
+        for fd in fds:
+            os.close(fd)
         return None
+    try:
+        return ShortCircuitCache._pread(fds[0], offset, length, resp)
     finally:
         for fd in fds:
             os.close(fd)
-        conn.close()
 
 
 def _verify(data: bytes, offset: int, resp: dict) -> bool:

@@ -34,6 +34,34 @@ class TestShortCircuit:
             assert c.read("/sc/f", offset=1234, length=999) == \
                 payload[1234:2233]
 
+    def test_cached_fd_revoked_on_delete_and_supersede(self, cluster):
+        """ShortCircuitRegistry.java:83 analog: the client CACHES granted
+        fds; deleting or appending the block flips the grant's shm slot,
+        so the next read drops the stale fd instead of serving stale
+        bytes."""
+        payload = np.random.default_rng(5).integers(
+            0, 256, size=150_000, dtype=np.uint8).tobytes()
+        with cluster.client("scr") as c:
+            c.write("/sc/rev", payload, scheme="direct")
+            assert c.read("/sc/rev") == payload
+            assert c.read("/sc/rev") == payload   # second read: cached fd
+            snap = metrics.registry("shortcircuit").snapshot()["counters"]
+            assert snap.get("cached_fd_reads", 0) > 0, \
+                "fd cache never hit"
+            assert c._sc_cache is not None and c._sc_cache._fds
+            # APPEND supersedes the block id: the cached fd maps the OLD
+            # inode; revocation must force a re-fetch of the new bytes
+            c.append("/sc/rev", b"TAIL" * 10)
+            got = c.read("/sc/rev")
+            assert got == payload + b"TAIL" * 10, \
+                "stale cached fd served pre-append bytes"
+            # DELETE revokes too: the next read of the (gone) block must
+            # not hit the dead cached fd
+            c.delete("/sc/rev")
+            snap = metrics.registry("shortcircuit").snapshot()["counters"]
+            assert snap.get("cached_fd_revoked", 0) > 0, \
+                "no grant was ever revoked"
+
     def test_reduced_block_falls_back_to_tcp(self, cluster):
         payload = (b"abcd" * 50_000)
         with cluster.client("sc2") as c:
