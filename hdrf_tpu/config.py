@@ -109,6 +109,15 @@ class NameNodeConfig:
     # monitor re-queues it (PendingReconstructionBlocks timeout analog).
     pending_replication_timeout_s: float = 30.0
     editlog_checkpoint_every: int = 1000  # ops between auto-checkpoints
+    # Federation (multiple nameservices over one DN set,
+    # BPOfferService.java:57): this NN's nameservice id and block-pool
+    # index.  The block pool is an ID RANGE — block ids are allocated as
+    # (pool_index << 48) | seq — so pools never collide and a DataNode
+    # partitions its reports per nameservice with a shift (the role
+    # BPOfferService's per-pool bookkeeping plays in the reference; chunk
+    # containers stay DN-wide, so dedup even spans namespaces).
+    nameservice_id: str = "ns0"
+    block_pool_index: int = 0
     # HA: "active" serves + writes the journal; "standby" tails it read-only
     # and answers (possibly slightly stale) reads until failover.
     role: str = "active"

@@ -179,7 +179,24 @@ class DataNode:
         self.cache = PinnedCache(config.cache_capacity)
         self.dn_id = dn_id or f"dn-{uuid.uuid4().hex[:8]}"
         from hdrf_tpu.proto.rpc import normalize_addrs
-        self._nns = [RpcClient(a) for a in normalize_addrs(namenode_addr)]
+
+        # Federation (BPOfferService.java:57 per namespace): accept either
+        # one nameservice's addr(s) or a LIST of nameservices (list of
+        # addr lists).  The DN registers/reports to every NN of every
+        # nameservice; block pools are disjoint id ranges, so reports are
+        # partitioned per NN by the pool index learned at registration.
+        def _is_ns_list(a) -> bool:
+            return (isinstance(a, (list, tuple)) and a
+                    and isinstance(a[0], (list, tuple)) and a[0]
+                    and isinstance(a[0][0], (list, tuple)))
+
+        self._nameservices = ([normalize_addrs(ns) for ns in namenode_addr]
+                              if _is_ns_list(namenode_addr)
+                              else [normalize_addrs(namenode_addr)])
+        self._nns = [RpcClient(a) for ns in self._nameservices for a in ns]
+        # RpcClient -> block_pool_index (from registration); None until
+        # learned, meaning "send everything, the NN pool-guards anyway"
+        self._pool_of: dict[int, int] = {}
         from hdrf_tpu.security import BlockTokenVerifier
         self.tokens = BlockTokenVerifier()
         self._receiver = BlockReceiver(self)
@@ -329,6 +346,11 @@ class DataNode:
             while self._ibr_queue:
                 block_id, length, gen_stamp = self._ibr_queue.pop(0)
                 for nn in self._nns:
+                    # pool-partitioned like full reports: a foreign NS's
+                    # NN would only bounce the IBR off its pool guard
+                    pool = self._pool_of.get(id(nn))
+                    if pool is not None and block_id >> 48 != pool:
+                        continue
                     try:
                         nn.call("block_received", dn_id=self.dn_id,
                                 block_id=block_id, length=length,
@@ -443,6 +465,8 @@ class DataNode:
                               storage_types=self.volume_types)
                 if resp.get("block_keys"):
                     self.tokens.update_keys(resp["block_keys"])
+                if "block_pool_index" in resp:
+                    self._pool_of[id(c)] = int(resp["block_pool_index"])
                 self._send_block_report(c)
                 ok += 1
             except (OSError, ConnectionError):
@@ -453,8 +477,11 @@ class DataNode:
     def _send_block_report(self, nn: RpcClient | None = None) -> None:
         report = [list(t) for t in self.replicas.block_report()]
         for c in ([nn] if nn else self._nns):
+            pool = self._pool_of.get(id(c))
+            rows = (report if pool is None
+                    else [t for t in report if t[0] >> 48 == pool])
             try:
-                c.call("block_report", dn_id=self.dn_id, blocks=report)
+                c.call("block_report", dn_id=self.dn_id, blocks=rows)
             except (OSError, ConnectionError):
                 if nn is not None:
                     raise  # caller handles (registration path)

@@ -246,7 +246,10 @@ class NameNode:
         # next check (the reference maintains counts on the quota INode for
         # the same reason: O(subtree) walks per create don't scale).
         self._qusage: dict[str, list | None] = {}
-        self._next_block_id = 1
+        # block ids live in this NN's block-pool range (federation):
+        # (pool_index << 48) | seq — disjoint across nameservices
+        self._pool_base = self.config.block_pool_index << 48
+        self._next_block_id = self._pool_base + 1
         self._gen_stamp = 1
         from hdrf_tpu.security import (BlockTokenSecretManager,
                                        DelegationTokenManager)
@@ -2210,7 +2213,11 @@ class NameNode:
                 self._tokens.maybe_roll()
                 keys = self._tokens.keys()
             return {"heartbeat_interval_s": self.config.heartbeat_interval_s,
-                    "block_keys": keys}
+                    "block_keys": keys,
+                    # block-pool identity (federation): the DN partitions
+                    # its reports/IBRs per nameservice by this id range
+                    "nameservice_id": self.config.nameservice_id,
+                    "block_pool_index": self.config.block_pool_index}
 
     def rpc_heartbeat(self, dn_id: str, stats: dict | None = None) -> dict:
         with self._lock:
@@ -2245,6 +2252,9 @@ class NameNode:
                 # multi-volume DNs report each replica's volume type
                 # (per-storage reports, DatanodeStorageInfo analog)
                 bid, gs, length = row[0], row[1], row[2]
+                if bid >> 48 != self.config.block_pool_index:
+                    continue  # another nameservice's pool: not ours to
+                    # track OR to invalidate (federation guard)
                 stype = row[3] if len(row) > 3 else None
                 info = self._blocks.get(bid)
                 if stype is not None and info is not None:
@@ -2323,6 +2333,8 @@ class NameNode:
         invariant lease recovery guarantees — only ``complete`` and
         ``commit_block_sync`` resolve lengths."""
         with self._lock:
+            if block_id >> 48 != self.config.block_pool_index:
+                return False   # another nameservice's pool (federation)
             dn = self._datanodes.get(dn_id)
             info = self._blocks.get(block_id)
             if dn is None:

@@ -100,6 +100,25 @@ class ShortCircuitRegistry:
                 mm.close()
                 _M.incr("shms_freed")
 
+    def release(self, shm_id: int, slot: int) -> None:
+        """Client voluntarily dropped a cached fd (eviction, failed pread)
+        — reclaim the slot (ReleaseShortCircuitAccessSlot analog); without
+        this, long-lived clients touching many blocks would drain the
+        segment and silently degrade to uncached reads."""
+        with self._lock:
+            mm = self._shms.get(shm_id)
+            if mm is None:
+                return
+            for bid, grants in list(self._grants.items()):
+                if (shm_id, slot) in grants:
+                    grants.remove((shm_id, slot))
+                    if not grants:
+                        del self._grants[bid]
+                    mm[slot] = 0
+                    self._free[shm_id].append(slot)
+                    _M.incr("slots_released")
+                    return
+
     def grant(self, shm_id: int, block_id: int) -> tuple[int, int] | None:
         """Allocate + validate a slot for a granted fd; returns
         (slot, generation) or None when the shm is unknown or full (the
@@ -174,6 +193,11 @@ class ShortCircuitServer:
         self._sock.listen(16)
         self.registry = ShortCircuitRegistry(os.path.dirname(sock_path)
                                              or ".")
+        # open liveness (alloc_shm) connections: stop() must sever them so
+        # clients learn the registry died — daemon handler threads outlive
+        # an in-process restart and would otherwise keep the channel open
+        self._live_conns: set = set()
+        self._live_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._serve,
                                         name="dn-shortcircuit", daemon=True)
@@ -189,6 +213,13 @@ class ShortCircuitServer:
         except OSError:
             pass
         self._sock.close()
+        with self._live_lock:
+            conns = list(self._live_conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         if os.path.exists(self.path):
             os.unlink(self.path)
 
@@ -214,20 +245,33 @@ class ShortCircuitServer:
                 # liveness channel (DomainSocketWatcher role): EOF means
                 # the client is gone and its segment + grants are freed.
                 shm_id, fd = self.registry.alloc_shm()
-                payload = json.dumps({"status": "ok",
-                                      "shm_id": shm_id}).encode()
-                prefix = len(payload).to_bytes(4, "little")
                 try:
-                    socket.send_fds(conn, [prefix], [fd])
+                    with self._live_lock:
+                        self._live_conns.add(conn)
+                    payload = json.dumps({"status": "ok",
+                                          "shm_id": shm_id}).encode()
+                    prefix = len(payload).to_bytes(4, "little")
+                    try:
+                        socket.send_fds(conn, [prefix], [fd])
+                    finally:
+                        os.close(fd)
+                    conn.sendall(payload)
+                    try:
+                        while conn.recv(1):
+                            pass   # client never writes; EOF = disconnect
+                    except OSError:
+                        pass
                 finally:
-                    os.close(fd)
-                conn.sendall(payload)
-                try:
-                    while conn.recv(1):
-                        pass   # client never writes; EOF = disconnect
-                except OSError:
-                    pass
-                self.registry.free_shm(shm_id)
+                    # freed on ANY exit — a client killed mid-handshake
+                    # must not leak the segment
+                    with self._live_lock:
+                        self._live_conns.discard(conn)
+                    self.registry.free_shm(shm_id)
+                return
+            if req.get("op") == "release":
+                self.registry.release(int(req["shm_id"]), int(req["slot"]))
+                payload = json.dumps({"status": "ok"}).encode()
+                conn.sendall(len(payload).to_bytes(4, "little") + payload)
                 return
             block_id = req["block_id"]
             # Same gate as the TCP read path: when block tokens are enabled,
@@ -343,8 +387,9 @@ class ShortCircuitCache:
         with self._lock:
             if sock_path in self._shm:
                 return self._shm[sock_path]
-        # the connection stays OPEN: it is the DN's liveness signal for
-        # this segment (close() -> EOF -> DN frees the shm + grants)
+        # the connection stays OPEN both ways: the DN frees the segment on
+        # our EOF, and WE learn the DN died/restarted from its EOF — an
+        # orphaned mmap would otherwise keep stale gen values forever
         resp, fds, conn = _request(sock_path, {"op": "alloc_shm"},
                                    keep_conn=True)
         mm = shm_id = None
@@ -356,38 +401,77 @@ class ShortCircuitCache:
                 mm = shm_id = None
         for fd in fds:
             os.close(fd)
-        if mm is None and conn is not None:
-            conn.close()
-            conn = None
+        if mm is None:
+            # transient failure: do NOT cache it, the next read retries
+            if conn is not None:
+                conn.close()
+            return (None, None, None)
+        conn.setblocking(False)
         with self._lock:
             if sock_path in self._shm:   # lost a setup race: keep first
-                if conn is not None:
-                    conn.close()
-                if mm is not None:
-                    mm.close()
+                conn.close()
+                mm.close()
             else:
                 self._shm[sock_path] = (mm, shm_id, conn)
             return self._shm[sock_path]
 
-    def _drop(self, key: tuple[str, int]) -> None:
+    def _dn_alive(self, sock_path: str, conn) -> bool:
+        """Poll the liveness connection: EOF/error means the DN (or its
+        registry) is gone — every grant from it is void."""
+        if conn is None:
+            return False
+        try:
+            if conn.recv(1) == b"":
+                raise OSError("EOF")
+            return True           # DN never writes; data would be a bug
+        except (BlockingIOError, InterruptedError):
+            return True
+        except OSError:
+            with self._lock:
+                ent = self._shm.pop(sock_path, None)
+                dead = [k for k in self._fds if k[0] == sock_path]
+                fds = [self._fds.pop(k)[0] for k in dead]
+            for fd in fds:
+                os.close(fd)
+            if ent is not None:
+                if ent[2] is not None:
+                    ent[2].close()
+                if ent[0] is not None:
+                    ent[0].close()
+            _M.incr("shm_channels_lost")
+            return False
+
+    def _drop(self, key: tuple[str, int], release: bool = True) -> None:
         with self._lock:
             ent = self._fds.pop(key, None)
-        if ent is not None:
-            os.close(ent[0])
+            shm = self._shm.get(key[0])
+        if ent is None:
+            return
+        os.close(ent[0])
+        if release and shm is not None and shm[1] is not None:
+            # hand the slot back (ReleaseShortCircuitAccessSlot): not
+            # doing so would drain the segment over a client's lifetime
+            _request(key[0], {"op": "release", "shm_id": shm[1],
+                              "slot": ent[1]})
 
     def read(self, sock_path: str, block_id: int, offset: int,
              length: int, token: dict | None = None) -> bytes | None:
         key = (sock_path, block_id)
         with self._lock:
             ent = self._fds.get(key)
-        mm, shm_id, _conn = self._shm_for(sock_path)
+        mm, shm_id, conn = self._shm_for(sock_path)
         if ent is not None:
             fd, slot, gen, resp = ent
-            if mm is None or mm[slot] != gen:
+            if mm is None or not self._dn_alive(sock_path, conn):
+                # DN gone/restarted: _dn_alive dropped every cached fd;
+                # try a fresh segment right away (restart case)
+                mm, shm_id, conn = self._shm_for(sock_path)
+            elif mm[slot] != gen:
                 # revoked (slot zeroed) or recycled to another grant (gen
-                # mismatch): either way this fd may map dead bytes
+                # mismatch): either way this fd may map dead bytes; the
+                # slot is already back in the DN's free list
                 _M.incr("cached_fd_revoked")
-                self._drop(key)
+                self._drop(key, release=False)
             else:
                 out = self._pread(fd, offset, length, resp)
                 if out is not None:

@@ -28,6 +28,7 @@ class MiniCluster:
                  journal_nodes: int = 0, secure: bool = False,
                  storage_types: list[str] | None = None,
                  volume_types: list[str] | None = None,
+                 nameservices: int = 1,
                  tpu_worker: bool = False):
         """``journal_nodes`` > 0 boots that many JournalNodes and puts the
         edit log on the quorum (MiniQJMHACluster analog); each NN then gets
@@ -60,6 +61,14 @@ class MiniCluster:
         self._heartbeat_s = heartbeat_s
         self.namenode: NameNode | None = None
         self.standby: NameNode | None = None  # MiniQJMHACluster analog
+        # Federation (MiniDFSNNTopology analog): ``nameservices`` > 1
+        # boots that many independent namespaces over the ONE DN set;
+        # each entry of ``self.ns`` is {"active": NN, "standby": NN|None}
+        # and NS 0 aliases self.namenode/self.standby.
+        self.nameservices_n = nameservices
+        assert not (nameservices > 1 and journal_nodes), \
+            "per-nameservice journal quorums are not wired in MiniCluster"
+        self.ns: list[dict] = []
         self.journalnodes: list = []
         self.datanodes: list[DataNode | None] = [None] * n_datanodes
 
@@ -82,19 +91,32 @@ class MiniCluster:
                 self.nn_config,
                 meta_dir=os.path.join(self.base_dir, "name-a"),
                 journal_addrs=[list(j.addr) for j in self.journalnodes])
-        self.namenode = NameNode(self.nn_config).start()
-        if self.ha:
-            sb_cfg = dataclasses.replace(self.nn_config, role="standby",
-                                         port=0)
-            if self.n_journal:
-                sb_cfg = dataclasses.replace(
-                    sb_cfg, meta_dir=os.path.join(self.base_dir, "name-b"),
-                    peers=[list(self.namenode.addr)])
-            self.standby = NameNode(sb_cfg).start()
-            if self.n_journal:
-                # peers must be symmetric: after a failover the DEMOTED
-                # original needs the new active for image bootstrap too
-                self.namenode.config.peers = [list(self.standby.addr)]
+        for nsi in range(self.nameservices_n):
+            cfg = self.nn_config
+            if self.nameservices_n > 1:
+                cfg = dataclasses.replace(
+                    cfg, nameservice_id=f"ns{nsi}", block_pool_index=nsi,
+                    meta_dir=os.path.join(self.base_dir, f"name-ns{nsi}"))
+            active = NameNode(cfg).start()
+            standby = None
+            if self.ha:
+                sb_cfg = dataclasses.replace(cfg, role="standby", port=0)
+                if self.n_journal:
+                    sb_cfg = dataclasses.replace(
+                        sb_cfg,
+                        meta_dir=os.path.join(self.base_dir,
+                                              f"name-b-ns{nsi}"
+                                              if self.nameservices_n > 1
+                                              else "name-b"),
+                        peers=[list(active.addr)])
+                standby = NameNode(sb_cfg).start()
+                if self.n_journal:
+                    # peers must be symmetric: after a failover the DEMOTED
+                    # original needs the new active for image bootstrap too
+                    active.config.peers = [list(standby.addr)]
+            self.ns.append({"active": active, "standby": standby})
+        self.namenode = self.ns[0]["active"]
+        self.standby = self.ns[0]["standby"]
         for i in range(self.n_datanodes):
             self.datanodes[i] = self._make_dn(i).start()
         self.wait_for_datanodes(self.n_datanodes)
@@ -103,19 +125,30 @@ class MiniCluster:
     def stop_journalnode(self, i: int) -> None:
         self.journalnodes[i].stop()
 
-    def nn_addrs(self) -> list:
-        addrs = [self.namenode.addr]
-        if self.standby is not None:
-            addrs.append(self.standby.addr)
+    def nn_addrs(self, nsi: int = 0) -> list:
+        """Addrs of ONE nameservice's NNs (active first)."""
+        ns = self.ns[nsi] if self.ns else {"active": self.namenode,
+                                           "standby": self.standby}
+        addrs = [ns["active"].addr]
+        if ns["standby"] is not None:
+            addrs.append(ns["standby"].addr)
         return addrs
 
-    def failover(self) -> NameNode:
-        """Kill the active NN and promote the standby (failover drill)."""
-        assert self.standby is not None, "not an HA cluster"
-        self.namenode.stop()
-        self.standby.rpc_transition_to_active()
-        self.namenode, self.standby = self.standby, None
-        return self.namenode
+    def all_ns_addrs(self) -> list:
+        """Nested per-nameservice addr lists (the DN's federation view)."""
+        return [self.nn_addrs(i) for i in range(len(self.ns) or 1)]
+
+    def failover(self, nsi: int = 0) -> NameNode:
+        """Kill a nameservice's active NN and promote its standby
+        (failover drill; other nameservices are untouched)."""
+        ns = self.ns[nsi]
+        assert ns["standby"] is not None, "not an HA cluster"
+        ns["active"].stop()
+        ns["standby"].rpc_transition_to_active()
+        ns["active"], ns["standby"] = ns["standby"], None
+        if nsi == 0:
+            self.namenode, self.standby = ns["active"], None
+        return ns["active"]
 
     def _make_dn(self, i: int) -> DataNode:
         cfg = DataNodeConfig(
@@ -131,16 +164,25 @@ class MiniCluster:
             cfg.storage_type = self.storage_types[i]
         if self.volume_types is not None:
             cfg.volume_types = list(self.volume_types)
-        return DataNode(cfg, self.nn_addrs(), dn_id=f"dn-{i}")
+        addr = (self.all_ns_addrs() if self.nameservices_n > 1
+                else self.nn_addrs())
+        return DataNode(cfg, addr, dn_id=f"dn-{i}")
 
     def stop(self) -> None:
         for dn in self.datanodes:
             if dn is not None:
                 dn.stop()
-        if self.standby is not None:
-            self.standby.stop()
-        if self.namenode is not None:
-            self.namenode.stop()
+        stopped = set()
+        for ns in self.ns:
+            for role in ("standby", "active"):
+                nn = ns[role]
+                if nn is not None and id(nn) not in stopped:
+                    stopped.add(id(nn))
+                    nn.stop()
+        for nn in (self.standby, self.namenode):
+            if nn is not None and id(nn) not in stopped:
+                stopped.add(id(nn))
+                nn.stop()
         for jn in self.journalnodes:
             try:
                 jn.stop()
@@ -181,10 +223,16 @@ class MiniCluster:
     def restart_namenode(self) -> NameNode:
         """Stop + boot the NameNode over the same meta dir AND the same port
         (so running DNs/clients reconnect) — exercises fsimage+edits recovery."""
+        import dataclasses
+
         port = self.namenode.addr[1]
+        # the RUNNING NN's config, not the base template: with federation
+        # ns0's meta_dir/identity were set by dataclasses.replace at start
+        cfg = dataclasses.replace(self.namenode.config, port=port)
         self.namenode.stop()
-        self.nn_config.port = port
-        self.namenode = NameNode(self.nn_config).start()
+        self.namenode = NameNode(cfg).start()
+        if self.ns:
+            self.ns[0]["active"] = self.namenode
         return self.namenode
 
     def restart_datanode(self, i: int) -> DataNode:
@@ -196,10 +244,12 @@ class MiniCluster:
 
     # ------------------------------------------------------------- helpers
 
-    def client(self, name: str | None = None) -> HdrfClient:
+    def client(self, name: str | None = None, nsi: int = 0) -> HdrfClient:
+        """A client of ONE nameservice (federation clients mount specific
+        namespaces, viewfs-style; there is no cross-NS client view)."""
         from hdrf_tpu.config import ClientConfig
 
-        addrs = self.nn_addrs()
+        addrs = self.nn_addrs(nsi)
         cfg = ClientConfig(encrypt_data_transfer=self.secure,
                            use_delegation_tokens=self.secure)
         return HdrfClient(addrs if len(addrs) > 1 else addrs[0], name=name,

@@ -34,33 +34,72 @@ class TestShortCircuit:
             assert c.read("/sc/f", offset=1234, length=999) == \
                 payload[1234:2233]
 
-    def test_cached_fd_revoked_on_delete_and_supersede(self, cluster):
-        """ShortCircuitRegistry.java:83 analog: the client CACHES granted
-        fds; deleting or appending the block flips the grant's shm slot,
-        so the next read drops the stale fd instead of serving stale
-        bytes."""
+    def test_cached_fd_revoked_on_replica_invalidate(self, cluster):
+        """ShortCircuitRegistry.java:83 'done' criterion: SC read with a
+        CACHED fd, the local replica is deleted (NN invalidate — the
+        balancer-move / excess-replica path), and the next read falls
+        back to a remote copy instead of serving the dead inode."""
         payload = np.random.default_rng(5).integers(
             0, 256, size=150_000, dtype=np.uint8).tobytes()
         with cluster.client("scr") as c:
             c.write("/sc/rev", payload, scheme="direct")
+            cluster.wait_for_replication("/sc/rev", 2)
             assert c.read("/sc/rev") == payload
             assert c.read("/sc/rev") == payload   # second read: cached fd
             snap = metrics.registry("shortcircuit").snapshot()["counters"]
-            assert snap.get("cached_fd_reads", 0) > 0, \
-                "fd cache never hit"
+            assert snap.get("cached_fd_reads", 0) > 0, "fd cache never hit"
             assert c._sc_cache is not None and c._sc_cache._fds
-            # APPEND supersedes the block id: the cached fd maps the OLD
-            # inode; revocation must force a re-fetch of the new bytes
-            c.append("/sc/rev", b"TAIL" * 10)
-            got = c.read("/sc/rev")
-            assert got == payload + b"TAIL" * 10, \
-                "stale cached fd served pre-append bytes"
-            # DELETE revokes too: the next read of the (gone) block must
-            # not hit the dead cached fd
-            c.delete("/sc/rev")
+            # the grant came from the FIRST location's DN; invalidate its
+            # replica (what an NN invalidate command does)
+            loc = c._nn.call("get_block_locations", path="/sc/rev")
+            binfo = loc["blocks"][0]
+            dn = cluster.datanodes[
+                int(binfo["locations"][0]["dn_id"].split("-")[1])]
+            dn._invalidate(binfo["block_id"])
+            # next read: slot is zeroed -> cached fd dropped -> re-request
+            # answers no_block -> remote fallback serves the good copy
+            assert c.read("/sc/rev") == payload, \
+                "read after invalidate did not fall back cleanly"
             snap = metrics.registry("shortcircuit").snapshot()["counters"]
             assert snap.get("cached_fd_revoked", 0) > 0, \
                 "no grant was ever revoked"
+
+    def test_append_after_cached_read_serves_new_bytes(self, cluster):
+        """Supersede flavor: whatever block layout append produces, a
+        client that cached fds beforehand must observe the appended
+        bytes."""
+        payload = np.random.default_rng(6).integers(
+            0, 256, size=150_000, dtype=np.uint8).tobytes()
+        with cluster.client("sca") as c:
+            c.write("/sc/app", payload, scheme="direct")
+            assert c.read("/sc/app") == payload
+            assert c.read("/sc/app") == payload   # cached
+            c.append("/sc/app", b"TAIL" * 10)
+            assert c.read("/sc/app") == payload + b"TAIL" * 10
+
+    def test_dn_restart_orphans_cached_fds_safely(self, cluster):
+        """A DN restart orphans the client's shm mapping (the new registry
+        knows nothing of old grants): the liveness channel's EOF must
+        invalidate every cached fd for that DN — reads after the restart
+        must never be served from a stale mapping."""
+        payload = np.random.default_rng(8).integers(
+            0, 256, size=120_000, dtype=np.uint8).tobytes()
+        with cluster.client("scrs") as c:
+            c.write("/sc/rs", payload, scheme="direct")
+            assert c.read("/sc/rs") == payload
+            assert c.read("/sc/rs") == payload   # cached fd in play
+            loc = c._nn.call("get_block_locations", path="/sc/rs")
+            holder = loc["blocks"][0]["locations"][0]["dn_id"]
+            i = int(holder.split("-")[1])
+            cluster.stop_datanode(i)
+            cluster.restart_datanode(i)
+            time.sleep(0.5)
+            c.append("/sc/rs", b"NEW")
+            assert c.read("/sc/rs") == payload + b"NEW", \
+                "stale cached fd survived the DN restart"
+            snap = metrics.registry("shortcircuit").snapshot()["counters"]
+            assert snap.get("shm_channels_lost", 0) > 0, \
+                "liveness channel never signaled the restart"
 
     def test_reduced_block_falls_back_to_tcp(self, cluster):
         payload = (b"abcd" * 50_000)
