@@ -183,6 +183,18 @@ def bench_recon(args) -> None:
     blocks = {}
     with tempfile.TemporaryDirectory() as d:
         cfg = ReductionConfig()
+        if args.chunk_kb:
+            # bigger lanes (the verdict's 64 KiB-lane case): per-lane
+            # dispatch overhead amortizes with lane size on both gather
+            # formulations
+            import math
+
+            from hdrf_tpu.config import CdcConfig
+
+            kb = args.chunk_kb
+            cfg = dataclasses.replace(cfg, cdc=CdcConfig(
+                mask_bits=int(math.log2(kb)) + 10,
+                min_chunk=(kb << 10) // 4, max_chunk=(kb << 10) * 4))
         ctx = ReductionContext(
             config=cfg,
             containers=ContainerStore(d + "/containers", codec="lz4"),
@@ -210,6 +222,77 @@ def bench_recon(args) -> None:
             mbps = total / (time.perf_counter() - t0) / 2**20
             print(json.dumps({"op": f"reconstruction [{label}]",
                               "MBps": round(mbps, 1)}))
+
+        # Device GATHER service rate: the kernel's own throughput once
+        # images are HBM-resident, with a tiny dependent readback (the
+        # same framing bench.py uses — through the dev tunnel every
+        # reconstructed byte pays the ~25 MB/s D2H link, which measures
+        # the WAN, not the gather; on PCIe-attached chips the D2H is
+        # noise and THIS rate bounds the read path).
+        import jax
+        import jax.numpy as jnp
+
+        if jax.default_backend() != "cpu":
+            from hdrf_tpu.ops.reconstruct import _bucket_of
+
+            recon = DeviceReconstructor()
+            s2 = dataclasses.replace(ctx, recon=recon)
+            for bid, data in blocks.items():   # stage images
+                assert s.reconstruct(bid, b"", len(data), s2) == data
+            # group every block's chunks like DeviceReconstructor.gather
+            jobs = []
+            for bid in blocks:
+                entry = ctx.index.get_block(bid)
+                locmap = ctx.index.lookup_chunks(list(set(entry.hashes)))
+                groups: dict = {}
+                for h in entry.hashes:
+                    loc = locmap[h]
+                    b = _bucket_of(-(-loc.length // 64) + 1)
+                    groups.setdefault((loc.container_id, b),
+                                      []).append(loc)
+                for (cid, b), locs in groups.items():
+                    L = -(-len(locs) // 128) * 128
+                    ol = np.zeros((2, L), np.int32)
+                    for j, loc in enumerate(locs):
+                        ol[0, j], ol[1, j] = loc.offset, loc.length
+                    img = recon._image(
+                        cid, lambda c=cid: ctx.containers.read_container(c))
+                    jobs.append((img, jax.device_put(ol), b,
+                                 sum(loc.length for loc in locs)))
+            from hdrf_tpu.ops.gather_pallas import gather_pad_messages
+
+            buckets = tuple(b for _, _, b, _ in jobs)
+            imgs = [j[0] for j in jobs]
+            ols = [j[1] for j in jobs]
+
+            INNER = 8
+
+            @jax.jit
+            def fused(imgs, ols):
+                # ONE device program per pass (per-group dispatches would
+                # measure the transport's per-dispatch cost, not the
+                # gather), with INNER salted iterations inside so the
+                # ~100 ms awaited-readback RTT amortizes (the slope
+                # method, PERF_NOTES.md; the +i byte offset defeats CSE
+                # while staying inside the images' zero headroom)
+                tot = jnp.uint64(0)
+                for i in range(INNER):
+                    for img, ol, b in zip(imgs, ols, buckets):
+                        o = gather_pad_messages(img, ol.at[0].add(i), b)
+                        tot += jnp.sum(o[:, :1].astype(jnp.uint64))
+                return tot
+
+            def one_pass():
+                return float(fused(imgs, ols))  # dependent readback
+
+            one_pass()  # compile
+            t0 = time.perf_counter()
+            for _ in range(args.repeats):
+                one_pass()
+            dt = time.perf_counter() - t0
+            gathered = args.repeats * INNER * sum(j[3] for j in jobs)
+            print(json.dumps({"op": "reconstruction [device gather kernel]",
+                              "MBps": round(gathered / dt / 2**20, 1)}))
         ctx.index.close()
 
 
@@ -236,6 +319,8 @@ def main(argv: list[str] | None = None) -> int:
     d = sub.add_parser("recon")
     d.add_argument("--mb", type=int, default=64)
     d.add_argument("--repeats", type=int, default=3)
+    d.add_argument("--chunk-kb", type=int, default=0,
+                   help="target avg chunk KiB (0 = config default ~8)")
     d.set_defaults(fn=bench_recon)
     args = p.parse_args(argv)
     args.fn(args)

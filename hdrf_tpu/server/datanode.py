@@ -209,6 +209,12 @@ class DataNode:
         # window of normalized downstream-transfer latencies per peer.
         self._peer_lat: dict[str, list[float]] = {}
         self._peer_lat_lock = threading.Lock()
+        import time as _time
+        # lifeline trigger clocks, PER NN (the reference's lifeline is
+        # per-BPServiceActor): a heartbeat landing at one NN must not
+        # suppress lifelines to another that is receiving none
+        now0 = _time.monotonic()
+        self._last_hb_ok = {id(nn): now0 for nn in self._nns}
 
         outer = self
 
@@ -248,6 +254,10 @@ class DataNode:
                               name=f"{self.dn_id}-heartbeat", daemon=True)
         hb.start()
         self._threads.append(hb)
+        ll = threading.Thread(target=self._lifeline_loop,
+                              name=f"{self.dn_id}-lifeline", daemon=True)
+        ll.start()
+        self._threads.append(ll)
         ibr = threading.Thread(target=self._ibr_loop,
                                name=f"{self.dn_id}-ibr", daemon=True)
         ibr.start()
@@ -498,6 +508,7 @@ class DataNode:
             for nn in self._nns:
                 try:
                     resp = nn.call("heartbeat", dn_id=self.dn_id, stats=stats)
+                    self._last_hb_ok[id(nn)] = _time.monotonic()
                     if resp.get("block_keys"):
                         self.tokens.update_keys(resp["block_keys"])
                     if resp.get("reregister"):
@@ -517,6 +528,33 @@ class DataNode:
                 except (OSError, ConnectionError):
                     _M.incr("heartbeat_failures")
                 last_report = now
+
+    def _lifeline_loop(self) -> None:
+        """DatanodeLifelineProtocol analog: a LOW-COST liveness-only
+        channel that keeps a loaded/stalled DN from being declared dead.
+        Fires only while the full heartbeat is overdue (the reference
+        sends lifelines whenever the service actor falls behind); the
+        NN's rpc_lifeline touches the liveness clock and nothing else —
+        no stats processing, no command queue, so it stays cheap exactly
+        when the node is struggling."""
+        import time as _time
+
+        interval = self.config.heartbeat_interval_s
+        while not self._stop.wait(interval):
+            now = _time.monotonic()
+            for nn in self._nns:
+                if now - self._last_hb_ok[id(nn)] <= 2 * interval:
+                    continue   # heartbeats flowing TO THIS NN: idle
+                try:
+                    resp = nn.call("lifeline", dn_id=self.dn_id)
+                    _M.incr("lifelines_sent")
+                    if resp.get("reregister"):
+                        # the NN restarted during the stall and has
+                        # forgotten us: a liveness touch on an unknown
+                        # dn_id keeps nothing alive
+                        self._register(nn)
+                except (OSError, ConnectionError):
+                    _M.incr("lifeline_failures")
 
     def note_peer_latency(self, dn_id: str, s_per_mb: float) -> None:
         with self._peer_lat_lock:

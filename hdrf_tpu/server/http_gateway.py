@@ -1,32 +1,56 @@
 """HTTP gateway: the WebHDFS REST surface + status pages.
 
 Re-expression of the reference's HTTP layer — `hdfs/web/WebHdfsFileSystem`
-(client) + the NN/DN webapps (`webapps/{hdfs,datanode}`) and JMX endpoints —
-as one stateless gateway process over the control/data protocols:
+(client, 4.0 kLoC) + the NN/DN webapps (`webapps/{hdfs,datanode}`) and JMX
+endpoints — as one stateless gateway process over the control/data
+protocols, with the reference's protocol shapes:
 
-  GET    /webhdfs/v1/<path>?op=LISTSTATUS
-  GET    /webhdfs/v1/<path>?op=GETFILESTATUS
-  GET    /webhdfs/v1/<path>?op=OPEN[&offset=N&length=N]
-  PUT    /webhdfs/v1/<path>?op=MKDIRS
-  PUT    /webhdfs/v1/<path>?op=CREATE[&scheme=S][&ec=P]     (body = bytes)
-  PUT    /webhdfs/v1/<path>?op=RENAME&destination=<dst>
-  DELETE /webhdfs/v1/<path>?op=DELETE
-  GET    /status      cluster overview (datanode report, live counts)
-  GET    /metrics     all metric registries (JMX/metrics2 analog)
+- **Two-step CREATE/APPEND/OPEN** (`WebHdfsFileSystem.java:136`'s redirect
+  dance): the namespace op answers `307 Temporary Redirect` with a
+  Location (or, with ``noredirect=true``, `200 {"Location": ...}`), and
+  the client re-issues the op WITH data against the redirect target — so
+  bulk bytes never ride the first request (the reference redirects to the
+  chosen DataNode's web server; this gateway redirects to its own
+  data-serving endpoint, the op shape and client contract identical).
+- **Delegation tokens in query params**: ``&delegation=<urlsafe-b64>``
+  authenticates any op (token-selector analog);
+  ``op=GETDELEGATIONTOKEN`` issues one.  ``user.name=<u>`` carries the
+  simple-auth identity otherwise.
+- FileSystem-parity ops: LISTSTATUS, GETFILESTATUS, GETCONTENTSUMMARY,
+  GETHOMEDIRECTORY, OPEN (ranged), CREATE, APPEND, MKDIRS, RENAME,
+  DELETE, TRUNCATE, SETPERMISSION, SETOWNER, SETREPLICATION,
+  CREATESYMLINK, GETDELEGATIONTOKEN, RENEWDELEGATIONTOKEN,
+  CANCELDELEGATIONTOKEN.
+
+  GET  /status   cluster overview; GET /metrics  JMX/metrics2 analog;
+  /dfshealth /datanode /journal /explorer  web UIs.
 """
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, unquote, urlparse
+from urllib.parse import parse_qs, quote, unquote, urlparse
+
+import msgpack
 
 from hdrf_tpu.client.filesystem import HdrfClient
 from hdrf_tpu.utils import metrics
 
 _M = metrics.registry("http_gateway")
 PREFIX = "/webhdfs/v1"
+
+
+def encode_token(token: dict) -> str:
+    """Delegation token -> URL-safe string (the reference's
+    Token.encodeToUrlString)."""
+    return base64.urlsafe_b64encode(msgpack.packb(token)).decode()
+
+
+def decode_token(s: str) -> dict:
+    return msgpack.unpackb(base64.urlsafe_b64decode(s.encode()), raw=False)
 
 
 class HttpGateway:
@@ -87,7 +111,7 @@ class HttpGateway:
                         return self._json(404, {"error": "not found"})
                     path = unquote(u.path[len(PREFIX):]) or "/"
                     op = q.get("op", "").upper()
-                    with HdrfClient(gateway._nn_addr, name="http-gw") as c:
+                    with gateway._client(q) as c:
                         return self._op(c, method, op, path, q)
                 except Exception as e:  # noqa: BLE001 — HTTP boundary
                     # RPC errors carry the server-side exception name
@@ -98,33 +122,115 @@ class HttpGateway:
                             "PermissionError": 403}.get(name, 500)
                     self._json(code, {"error": name, "message": str(e)})
 
+            def _redirect(self, path: str, q: dict) -> None:
+                """Step 1 of the two-step write/open protocol: answer with
+                the data endpoint's URL (307, or JSON with noredirect) —
+                the client re-issues the op THERE with the payload."""
+                # drain any body a non-conforming client sent on step 1:
+                # unread bytes would be parsed as the next request line on
+                # this keep-alive connection (HTTP/1.1 desync)
+                self._body()
+                keep = {k: v for k, v in q.items() if k != "noredirect"}
+                keep["step"] = "2"
+                loc = (f"http://{self.headers.get('Host', 'localhost')}"
+                       f"{PREFIX}{quote(path)}?"
+                       + "&".join(f"{k}={quote(str(v), safe='')}"
+                                  for k, v in keep.items()))
+                if q.get("noredirect", "").lower() == "true":
+                    return self._json(200, {"Location": loc})
+                body = b""
+                self.send_response(307)
+                self.send_header("Location", loc)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n)
+
             def _op(self, c: HdrfClient, method: str, op: str, path: str,
                     q: dict) -> None:
+                two_step = "step" not in q
                 if method == "GET" and op == "LISTSTATUS":
                     self._json(200, {"FileStatuses": {
                         "FileStatus": c.ls(path)}})
                 elif method == "GET" and op == "GETFILESTATUS":
                     self._json(200, {"FileStatus": c.stat(path)})
+                elif method == "GET" and op == "GETCONTENTSUMMARY":
+                    self._json(200, {"ContentSummary":
+                                     c._call("content_summary", path=path)})
+                elif method == "GET" and op == "GETHOMEDIRECTORY":
+                    self._json(200, {"Path": f"/user/{c.user}"})
                 elif method == "GET" and op == "OPEN":
+                    if two_step:
+                        # the reference always redirects OPEN to the data
+                        # endpoint; GET clients follow 307 transparently
+                        return self._redirect(path, q)
                     data = c.read(path, offset=int(q.get("offset", 0)),
                                   length=int(q.get("length", -1)))
                     self._bytes(data)
                 elif method == "PUT" and op == "MKDIRS":
                     self._json(200, {"boolean": c.mkdir(path)})
                 elif method == "PUT" and op == "CREATE":
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(n)
+                    if two_step:
+                        return self._redirect(path, q)
+                    body = self._body()
                     c.write(path, body, scheme=q.get("scheme"),
                             ec=q.get("ec"))
                     self._json(201, {"length": len(body)})
+                elif method == "POST" and op == "APPEND":
+                    if two_step:
+                        return self._redirect(path, q)
+                    body = self._body()
+                    c.append(path, body)
+                    self._json(200, {"length": len(body)})
+                elif method == "POST" and op == "TRUNCATE":
+                    ok = c._call("truncate", path=path,
+                                 new_length=int(q["newlength"]))
+                    self._json(200, {"boolean": ok})
                 elif method == "PUT" and op == "RENAME":
                     self._json(200, {"boolean": c.rename(
                         path, q["destination"])})
+                elif method == "PUT" and op == "SETPERMISSION":
+                    c._call("set_permission", path=path,
+                            mode=int(q.get("permission", "755"), 8))
+                    self._json(200, {})
+                elif method == "PUT" and op == "SETOWNER":
+                    c._call("set_owner", path=path,
+                            owner=q.get("owner", ""),
+                            group=q.get("group", ""))
+                    self._json(200, {})
+                elif method == "PUT" and op == "SETREPLICATION":
+                    ok = c._call("set_replication", path=path,
+                                 replication=int(q.get("replication", 3)))
+                    self._json(200, {"boolean": ok})
+                elif method == "PUT" and op == "CREATESYMLINK":
+                    c._call("create_symlink", link=path,
+                            target=q["destination"])
+                    self._json(200, {})
                 elif method == "DELETE" and op == "DELETE":
                     self._json(200, {"boolean": c.delete(path)})
+                elif method == "GET" and op == "GETDELEGATIONTOKEN":
+                    tok = c._nn.call("get_delegation_token",
+                                     renewer=q.get("renewer", c.user),
+                                     owner=c.user)
+                    self._json(200, {"Token":
+                                     {"urlString": encode_token(tok)}})
+                elif method == "PUT" and op == "RENEWDELEGATIONTOKEN":
+                    exp = c._nn.call("renew_delegation_token",
+                                     token=decode_token(q["token"]))
+                    self._json(200, {"long": exp})
+                elif method == "PUT" and op == "CANCELDELEGATIONTOKEN":
+                    c._nn.call("cancel_delegation_token",
+                               token=decode_token(q["token"]))
+                    self._json(200, {})
                 else:
                     self._json(400, {"error": "UnsupportedOperationException",
                                      "message": f"{method} {op}"})
+
+            def do_POST(self):
+                self._dispatch("POST")
 
             def do_GET(self):
                 self._dispatch("GET")
@@ -142,6 +248,20 @@ class HttpGateway:
     @property
     def addr(self) -> tuple[str, int]:
         return self._server.server_address
+
+    def _client(self, q: dict) -> HdrfClient:
+        """Per-request client with the caller's identity: a delegation
+        token from the query params (its owner becomes the acting user —
+        the token-selector analog) or simple-auth ``user.name``."""
+        tok = None
+        user = q.get("user.name")
+        if "delegation" in q:
+            tok = decode_token(q["delegation"])
+            user = tok.get("owner") or user
+        c = HdrfClient(self._nn_addr, name="http-gw", user=user)
+        if tok is not None:
+            c._dtoken = tok
+        return c
 
     def start(self) -> "HttpGateway":
         self._thread.start()

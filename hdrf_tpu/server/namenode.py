@@ -2219,6 +2219,20 @@ class NameNode:
                     "nameservice_id": self.config.nameservice_id,
                     "block_pool_index": self.config.block_pool_index}
 
+    def rpc_lifeline(self, dn_id: str) -> dict:
+        """DatanodeLifelineProtocol analog: touch ONLY the liveness clock.
+        No stats, no commands, no key rolls — the whole point is staying
+        cheap while the DN (or this NN) is too loaded for full
+        heartbeats, so an overloaded-but-alive node is not declared dead
+        and mass re-replicated."""
+        with self._lock:
+            dn = self._datanodes.get(dn_id)
+            if dn is None:
+                return {"reregister": True}
+            dn.last_heartbeat = time.monotonic()
+            _M.incr("lifelines")
+            return {}
+
     def rpc_heartbeat(self, dn_id: str, stats: dict | None = None) -> dict:
         with self._lock:
             dn = self._datanodes.get(dn_id)
@@ -2871,7 +2885,7 @@ class NameNode:
     # and journal plumbing, and token acquisition itself (the kerberos leg
     # that gates issuance in the reference has no analog here).
     _AUTH_EXEMPT = frozenset({
-        "register_datanode", "heartbeat", "block_report",
+        "register_datanode", "heartbeat", "lifeline", "block_report",
         "incremental_block_report", "bad_block", "block_received",
         "commit_block_sync", "ha_state", "transition_to_active",
         "fetch_image", "get_delegation_token", "renew_delegation_token",

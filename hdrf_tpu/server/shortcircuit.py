@@ -100,15 +100,18 @@ class ShortCircuitRegistry:
                 mm.close()
                 _M.incr("shms_freed")
 
-    def release(self, shm_id: int, slot: int) -> None:
+    def release(self, shm_id: int, slot: int, gen: int) -> None:
         """Client voluntarily dropped a cached fd (eviction, failed pread)
         — reclaim the slot (ReleaseShortCircuitAccessSlot analog); without
         this, long-lived clients touching many blocks would drain the
-        segment and silently degrade to uncached reads."""
+        segment and silently degrade to uncached reads.  The GENERATION
+        must match: a release racing a concurrent revoke+re-grant of the
+        same slot would otherwise free ANOTHER grant's slot and
+        double-insert it into the free list."""
         with self._lock:
             mm = self._shms.get(shm_id)
-            if mm is None:
-                return
+            if mm is None or self._gen.get((shm_id, slot)) != gen:
+                return   # stale release: the slot moved on
             for bid, grants in list(self._grants.items()):
                 if (shm_id, slot) in grants:
                     grants.remove((shm_id, slot))
@@ -269,7 +272,8 @@ class ShortCircuitServer:
                     self.registry.free_shm(shm_id)
                 return
             if req.get("op") == "release":
-                self.registry.release(int(req["shm_id"]), int(req["slot"]))
+                self.registry.release(int(req["shm_id"]), int(req["slot"]),
+                                      int(req.get("gen", -1)))
                 payload = json.dumps({"status": "ok"}).encode()
                 conn.sendall(len(payload).to_bytes(4, "little") + payload)
                 return
@@ -450,9 +454,10 @@ class ShortCircuitCache:
         os.close(ent[0])
         if release and shm is not None and shm[1] is not None:
             # hand the slot back (ReleaseShortCircuitAccessSlot): not
-            # doing so would drain the segment over a client's lifetime
+            # doing so would drain the segment over a client's lifetime;
+            # the generation guards against racing a revoke+re-grant
             _request(key[0], {"op": "release", "shm_id": shm[1],
-                              "slot": ent[1]})
+                              "slot": ent[1], "gen": ent[2]})
 
     def read(self, sock_path: str, block_id: int, offset: int,
              length: int, token: dict | None = None) -> bytes | None:

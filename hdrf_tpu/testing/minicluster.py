@@ -228,7 +228,11 @@ class MiniCluster:
         port = self.namenode.addr[1]
         # the RUNNING NN's config, not the base template: with federation
         # ns0's meta_dir/identity were set by dataclasses.replace at start
-        cfg = dataclasses.replace(self.namenode.config, port=port)
+        # role is forced active: a promoted ex-standby's CONFIG still says
+        # standby (transition_to_active flips the runtime role only), and
+        # restarting it as a standby would leave the cluster activeless
+        cfg = dataclasses.replace(self.namenode.config, port=port,
+                                  role="active")
         self.namenode.stop()
         self.namenode = NameNode(cfg).start()
         if self.ns:

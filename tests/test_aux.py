@@ -196,7 +196,12 @@ class TestHttpGateway:
 
             assert put("/webhdfs/v1/web/d?op=MKDIRS")["boolean"]
             payload = b"hello web " * 10_000
-            put("/webhdfs/v1/web/f?op=CREATE&scheme=lz4", payload)
+            # two-step CREATE (WebHdfsFileSystem redirect dance): ask for
+            # the data location, then PUT the bytes there
+            loc = put("/webhdfs/v1/web/f?op=CREATE&scheme=lz4"
+                      "&noredirect=true")["Location"]
+            assert "step=2" in loc
+            put(loc[loc.index("/webhdfs"):], payload)
             st = json.loads(get("/webhdfs/v1/web/f?op=GETFILESTATUS"))
             assert st["FileStatus"]["length"] == len(payload)
             assert get("/webhdfs/v1/web/f?op=OPEN") == payload
@@ -465,3 +470,42 @@ class TestSlowPeers:
                 f"no peer reports reached the NN: {rep}"
             assert rep["slow_peers"] == {}, \
                 f"healthy peers falsely flagged: {rep}"
+
+
+class TestLifeline:
+    def test_lifeline_keeps_stalled_dn_alive(self):
+        """DatanodeLifelineProtocol analog: a DN whose full heartbeats
+        stall (busy service actor) keeps sending cheap lifelines, so the
+        NN never declares it dead and never mass-re-replicates."""
+        from hdrf_tpu.utils import fault_injection
+
+        with MiniCluster(n_datanodes=2, replication=2, heartbeat_s=0.2,
+                         dead_node_s=1.2) as mc:
+            dn = mc.datanodes[0]
+
+            def stall(**kw):
+                if kw.get("dn_id") == dn.dn_id:
+                    raise RuntimeError("simulated service-actor stall")
+
+            fault_injection.install("datanode.heartbeat", stall)
+            try:
+                time.sleep(2.5)   # well past the dead-node interval
+                report = mc.namenode.rpc_datanode_report()
+                me = next(d for d in report if d["dn_id"] == dn.dn_id)
+                assert me["alive"], "lifelines failed to keep the DN alive"
+                assert metrics.registry("datanode").snapshot()[
+                    "counters"].get("lifelines_sent", 0) > 0
+                assert metrics.registry("namenode").snapshot()[
+                    "counters"].get("lifelines", 0) > 0
+            finally:
+                fault_injection.remove("datanode.heartbeat")
+
+    def test_lifeline_idle_when_heartbeats_flow(self):
+        with MiniCluster(n_datanodes=1, replication=1,
+                         heartbeat_s=0.2) as mc:
+            before = metrics.registry("datanode").snapshot()[
+                "counters"].get("lifelines_sent", 0)
+            time.sleep(1.2)
+            after = metrics.registry("datanode").snapshot()[
+                "counters"].get("lifelines_sent", 0)
+            assert after == before, "lifeline fired while heartbeats flow"

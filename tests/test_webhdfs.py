@@ -1,0 +1,165 @@
+"""WebHDFS depth (WebHdfsFileSystem.java:136 analog): a pure-HTTP client
+driving the filesystem — two-step CREATE/APPEND redirects, ranged OPEN,
+delegation tokens in query params, and the FileSystem-parity op set."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hdrf_tpu.server.http_gateway import HttpGateway
+from hdrf_tpu.testing.minicluster import MiniCluster
+
+
+class _HttpFs:
+    """Minimal WebHDFS client: ONLY http requests, no RPC imports — what
+    an external tool (curl, requests) would do."""
+
+    def __init__(self, base: str, delegation: str | None = None):
+        self.base = base
+        self.delegation = delegation
+
+    def _url(self, path: str, op: str, **params) -> str:
+        q = [f"op={op}"] + [f"{k}={v}" for k, v in params.items()]
+        if self.delegation:
+            q.append(f"delegation={self.delegation}")
+        return f"{self.base}/webhdfs/v1{path}?" + "&".join(q)
+
+    def _req(self, method: str, url: str, data: bytes | None = None,
+             follow: bool = True):
+        req = urllib.request.Request(url, data=data, method=method)
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 307 and follow:
+                # urllib only auto-follows GET; re-issue writes manually
+                return self._req(method, e.headers["Location"], data,
+                                 follow=False)
+            raise
+
+    def _two_step(self, method: str, path: str, op: str, data: bytes,
+                  **params) -> int:
+        # step 1 carries NO body (the reference client sends the payload
+        # only to the redirect target — that is the point of the dance);
+        # noredirect=true fetches the Location as JSON
+        out = self.op_json(method, path, op, noredirect="true", **params)
+        st, _ = self._req(method, out["Location"], data, follow=False)
+        return st
+
+    def write(self, path: str, data: bytes, **params) -> None:
+        assert self._two_step("PUT", path, "CREATE", data, **params) == 201
+
+    def append(self, path: str, data: bytes) -> None:
+        assert self._two_step("POST", path, "APPEND", data) == 200
+
+    def read(self, path: str, **params) -> bytes:
+        _, body = self._req("GET", self._url(path, "OPEN", **params))
+        return body
+
+    def op_json(self, method: str, path: str, op: str, **params):
+        st, body = self._req(method, self._url(path, op, **params))
+        return json.loads(body) if body else {}
+
+
+@pytest.fixture
+def fs():
+    with MiniCluster(n_datanodes=2, replication=2,
+                     block_size=1 << 20) as mc:
+        gw = HttpGateway(mc.namenode.addr).start()
+        try:
+            yield _HttpFs(f"http://{gw.addr[0]}:{gw.addr[1]}"), mc
+        finally:
+            gw.stop()
+
+
+class TestWebHdfsFileSystem:
+    def test_http_only_write_read_lifecycle(self, fs):
+        http, _ = fs
+        payload = np.random.default_rng(3).integers(
+            0, 256, 2_500_000, np.uint8).tobytes()  # spans 3 blocks
+        assert http.op_json("PUT", "/w/d", "MKDIRS")["boolean"]
+        http.write("/w/d/f", payload)
+        assert http.read("/w/d/f") == payload
+        # ranged OPEN through the redirect
+        assert http.read("/w/d/f", offset=1_100_000, length=5000) == \
+            payload[1_100_000:1_105_000]
+        st = http.op_json("GET", "/w/d/f", "GETFILESTATUS")["FileStatus"]
+        assert st["length"] == len(payload)
+        cs = http.op_json("GET", "/w", "GETCONTENTSUMMARY")[
+            "ContentSummary"]
+        assert cs["length"] == len(payload)
+        # append over HTTP (two-step POST)
+        http.append("/w/d/f", b"tail-bytes")
+        assert http.read("/w/d/f") == payload + b"tail-bytes"
+        # truncate
+        assert http.op_json("POST", "/w/d/f", "TRUNCATE",
+                            newlength=1000)["boolean"]
+        assert http.read("/w/d/f") == payload[:1000]
+        # rename + liststatus + delete
+        assert http.op_json("PUT", "/w/d/f", "RENAME",
+                            destination="/w/d/g")["boolean"]
+        ls = http.op_json("GET", "/w/d", "LISTSTATUS")
+        assert {e["name"] for e in
+                ls["FileStatuses"]["FileStatus"]} == {"g"}
+        assert http.op_json("DELETE", "/w/d/g", "DELETE")["boolean"]
+
+    def test_two_step_redirect_shape(self, fs):
+        http, _ = fs
+        # noredirect=true answers 200 + Location instead of a 307
+        out = http.op_json("PUT", "/r/f", "CREATE", noredirect="true")
+        assert "step=2" in out["Location"]
+        # a bare PUT answers a real 307 with a Location header
+        req = urllib.request.Request(http._url("/r/f", "CREATE"),
+                                     method="PUT")
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 307")
+        except urllib.error.HTTPError as e:
+            assert e.code == 307 and "step=2" in e.headers["Location"]
+
+    def test_permissions_and_ownership_ops(self, fs):
+        http, mc = fs
+        http.write("/p/f", b"perm")
+        http.op_json("PUT", "/p/f", "SETPERMISSION", permission="600")
+        st = http.op_json("GET", "/p/f", "GETFILESTATUS")["FileStatus"]
+        assert int(st.get("permission", st.get("mode", 0))) in (0o600, 600,
+                                                                384)
+        assert http.op_json("PUT", "/p/f", "SETREPLICATION",
+                            replication=1)["boolean"]
+
+    def test_delegation_token_in_query_params(self, fs):
+        """Token-authenticated HTTP access against an NN that REQUIRES
+        tokens: GETDELEGATIONTOKEN -> use &delegation= on every op."""
+        http, mc = fs
+        tok = http.op_json("GET", "/", "GETDELEGATIONTOKEN",
+                           renewer="web")["Token"]["urlString"]
+        assert tok
+        mc.namenode.config.require_token_auth = True
+        try:
+            authed = _HttpFs(http.base, delegation=tok)
+            authed.write("/t/f", b"token bytes")
+            assert authed.read("/t/f") == b"token bytes"
+            # renew + cancel round trip
+            exp = authed.op_json("PUT", "/", "RENEWDELEGATIONTOKEN",
+                                 token=tok)["long"]
+            assert exp > 0
+            # without a token the namespace op is refused
+            with pytest.raises(urllib.error.HTTPError):
+                http.op_json("GET", "/t/f", "GETFILESTATUS")
+            authed.op_json("PUT", "/", "CANCELDELEGATIONTOKEN", token=tok)
+            with pytest.raises(urllib.error.HTTPError):
+                authed.op_json("GET", "/t/f", "GETFILESTATUS")
+        finally:
+            mc.namenode.config.require_token_auth = False
+
+    def test_symlink_and_home(self, fs):
+        http, _ = fs
+        http.write("/s/target", b"sym")
+        http.op_json("PUT", "/s/link", "CREATESYMLINK",
+                     destination="/s/target")
+        assert http.read("/s/link") == b"sym"
+        assert http.op_json("GET", "/", "GETHOMEDIRECTORY")[
+            "Path"].startswith("/user/")
