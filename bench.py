@@ -260,11 +260,12 @@ def main() -> None:
 
         one_pass()                              # compile all batched shapes
 
-        # best of three passes: the tunneled transport's dispatch latency
-        # varies run to run; the better pass is closer to the device-bound
-        # rate
+        # best of five passes: the tunneled transport's dispatch latency
+        # varies run to run (a whole RUN has measured 770-1200 MB/s for
+        # identical device work); the best pass is closest to the
+        # device-bound rate
         value = 0.0
-        for _ in range(3):
+        for _ in range(5):
             t0 = time.perf_counter()
             results = one_pass()
             dt = time.perf_counter() - t0

@@ -212,6 +212,50 @@ def _sha_chunks_sharded(mesh: Mesh, bucket: int, pad_words: int):
     return fn
 
 
+_sha_halo_fns: dict = {}
+
+
+def _sha_chunks_halo(mesh: Mesh, bucket: int, pad_words: int,
+                     halo_shards: int):
+    """Data-LOCAL sharded SHA: each chunk is hashed by a device at its
+    OWNING seq position, whose image is its own shard plus ``halo_shards``
+    neighbor shards fetched with a ppermute ring walk — ICI traffic is
+    halo_shards x (block/n_seq) per device instead of the full-image
+    all_gather's (n_seq-1) x (block/n_seq) (the r3 verdict's economics
+    note; the halo pattern is the scaling-book neighbor-exchange recipe,
+    same as the candidate scan's WINDOW halo).  Over-read bytes past a
+    chunk (next chunks' data, or ring-wrapped bytes on the last shard)
+    are masked by _bucket_sha's SHA-padding splice, so output stays
+    bit-identical.  Lanes land as (n_data, n_seq, Lmax) blocks; the host
+    unpermutes digests by its own owner assignment."""
+    from hdrf_tpu.ops.resident import _bucket_sha, be_word_image
+
+    key = (mesh, bucket, pad_words, halo_shards)
+    fn = _sha_halo_fns.get(key)
+    if fn is not None:
+        return fn
+    n_seq = mesh.shape["seq"]
+    perm = [(i, (i - 1) % n_seq) for i in range(n_seq)]  # fetch NEXT shard
+
+    def local(block_shard: jax.Array, ol: jax.Array) -> jax.Array:
+        parts = [block_shard]
+        cur = block_shard
+        for _ in range(halo_shards):
+            cur = jax.lax.ppermute(cur, "seq", perm)
+            parts.append(cur)
+        img = jnp.concatenate(parts)
+        words = jnp.concatenate([be_word_image(img),
+                                 jnp.zeros(pad_words, jnp.uint32)])
+        return _bucket_sha(words, ol[0, 0], bucket)
+
+    fn = jax.jit(_shard_map(
+        local, mesh=mesh,
+        in_specs=(P("seq"), P("data", "seq")),
+        out_specs=P(("data", "seq"))))
+    _sha_halo_fns[key] = fn
+    return fn
+
+
 def reduce_sharded(data: bytes | np.ndarray, cdc, mesh: Mesh):
     """(cuts, digests) for ONE block with every stage on the mesh — the
     multi-chip form of ops.dispatch.chunk_and_fingerprint, bit-identical
@@ -259,12 +303,51 @@ def reduce_sharded(data: bytes | np.ndarray, cdc, mesh: Mesh):
     # single-device path's finer bucketing is a padded-FLOPs optimization,
     # not a correctness requirement)
     bucket = _bucket_of((cdc.max_chunk + 9 + 63) // 64)
+    pad_words = -(-(bucket * 16 + 16) // 128) * 128
+    n_data, n_seq = mesh.shape["data"], mesh.shape["seq"]
+    shard_bytes = buf.size // n_seq
+    # halo shards covering one full gather window past a shard boundary
+    halo = -(-(bucket * 64 + 64) // shard_bytes)
+    if halo < n_seq - 1:
+        # DATA-LOCAL SHA: each chunk hashed at its owning seq position
+        # (+round-robin over 'data'), image = own shard + ppermute halo —
+        # ICI bytes per device drop from (n_seq-1) to `halo` shards
+        # vectorized owner assignment (a 1 GiB block has ~131k chunks;
+        # python-loop assignment would stall the pipeline between
+        # dispatches): rank chunks within their seq shard, round-robin
+        # the rank across 'data', lane index = rank // n_data
+        owner_seq = np.minimum(starts // shard_bytes,
+                               n_seq - 1).astype(np.int64)
+        counts = np.bincount(owner_seq, minlength=n_seq)
+        order = np.argsort(owner_seq, kind="stable")
+        group_base = np.cumsum(counts) - counts
+        rank = np.empty(nchunks, dtype=np.int64)
+        rank[order] = (np.arange(nchunks)
+                       - np.repeat(group_base, counts))
+        d_arr = rank % n_data
+        j_arr = rank // n_data
+        # jit shape key: quantize the per-cell lane count to power-of-two
+        # 128-lane steps — a data-dependent exact lmax would retrace per
+        # block (the stable-key property the bucket choice exists for)
+        max_cell = max(int(j_arr.max()) + 1 if nchunks else 1, 1)
+        lmax = 128 << max(0, (max_cell - 1).bit_length() - 7) \
+            if max_cell > 128 else 128
+        ol_all = np.zeros((n_data, n_seq, 2, lmax), dtype=np.int32)
+        ol_all[d_arr, owner_seq, 0, j_arr] = starts - owner_seq * shard_bytes
+        ol_all[d_arr, owner_seq, 1, j_arr] = lens
+        fn = _sha_chunks_halo(mesh, bucket, pad_words, halo)
+        ol_dev = jax.device_put(
+            ol_all, NamedSharding(mesh, P("data", "seq")))
+        out = np.asarray(fn(block_sh, ol_dev))
+        digests = out[(d_arr * n_seq + owner_seq) * lmax + j_arr]
+        return cuts, digests
+    # tiny blocks / shards smaller than the gather window: the halo walk
+    # would re-build the full image anyway — all_gather is the right tool
     lane_grid = 128 * ndev
     L = max(-(-nchunks // lane_grid) * lane_grid, lane_grid)
     ol = np.zeros((2, L), dtype=np.int32)
     ol[0, :nchunks] = starts
     ol[1, :nchunks] = lens
-    pad_words = -(-(bucket * 16 + 16) // 128) * 128
     fn = _sha_chunks_sharded(mesh, bucket, pad_words)
     ol_dev = jax.device_put(
         ol, NamedSharding(mesh, P(None, tuple(mesh.axis_names))))

@@ -173,3 +173,62 @@ class TestRealPipelineSharded:
         wc = native.cdc_chunk(tiny, gear_mask(cdc), cdc.min_chunk,
                               cdc.max_chunk)
         np.testing.assert_array_equal(np.asarray(cuts), wc)
+
+
+class TestHaloShaEconomics:
+    """r3 verdict weak #6: the sharded SHA stage must not all_gather the
+    full image when a neighbor halo suffices (ICI bytes: halo x shard vs
+    (n_seq-1) x shard per device)."""
+
+    def test_halo_path_engages_and_matches_oracle(self, monkeypatch):
+        import jax
+
+        from hdrf_tpu import native
+        from hdrf_tpu.config import CdcConfig
+        from hdrf_tpu.ops.dispatch import gear_mask
+        import hdrf_tpu.parallel.sharded as sh
+
+        used = {}
+        real = sh._sha_chunks_halo
+
+        def spy(mesh, bucket, pad_words, halo):
+            used["halo"] = halo
+            return real(mesh, bucket, pad_words, halo)
+
+        monkeypatch.setattr(sh, "_sha_chunks_halo", spy)
+        # data x seq mesh: owners round-robin across the data axis too
+        cdc = CdcConfig()
+        mesh = sh.make_mesh(n_data=2, n_seq=len(jax.devices()) // 2)
+        rng = np.random.default_rng(63)
+        data = rng.integers(0, 256, size=2_000_000, dtype=np.uint8)
+        data[:600_000] = rng.integers(97, 123, size=600_000, dtype=np.uint8)
+        cuts, digs = sh.reduce_sharded(np.ascontiguousarray(data), cdc,
+                                       mesh)
+        assert "halo" in used, "halo SHA path did not engage"
+        assert used["halo"] < mesh.shape["seq"] - 1
+        wc = native.cdc_chunk(data, gear_mask(cdc), cdc.min_chunk,
+                              cdc.max_chunk)
+        starts = np.concatenate([[0], wc[:-1]]).astype(np.uint64)
+        wd = native.sha256_batch(data, starts, (wc - starts).astype(np.uint64))
+        np.testing.assert_array_equal(np.asarray(cuts), wc)
+        np.testing.assert_array_equal(digs, wd)
+
+    def test_tiny_block_falls_back_to_all_gather(self, monkeypatch):
+        import jax
+
+        from hdrf_tpu.config import CdcConfig
+        import hdrf_tpu.parallel.sharded as sh
+
+        called = {}
+        monkeypatch.setattr(
+            sh, "_sha_chunks_halo",
+            lambda *a: called.setdefault("halo", True) or (_ for _ in ()))
+        cdc = CdcConfig()
+        mesh = sh.make_mesh(n_data=1, n_seq=len(jax.devices()))
+        rng = np.random.default_rng(64)
+        data = rng.integers(0, 256, size=30_000, dtype=np.uint8)
+        cuts, digs = sh.reduce_sharded(np.ascontiguousarray(data), cdc,
+                                       mesh)
+        assert "halo" not in called, \
+            "tiny shards must use the all_gather path"
+        assert int(cuts[-1]) == data.size
