@@ -425,7 +425,7 @@ class TestSlowPeers:
             for dn in mc.datanodes[:2]:
                 for _ in range(8):
                     dn.note_peer_latency("dn-2", 50.0)  # 50 s/MB
-            deadline = time.time() + 6
+            deadline = time.time() + 12  # generous: CI hosts load-spike
             while time.time() < deadline:
                 rep = mc.namenode.rpc_slow_peers()
                 if "dn-2" in rep["slow_peers"]:
