@@ -58,6 +58,13 @@ class PinnedCache:
         self._data: dict[int, bytes] = {}
         self._used = 0
 
+    def set_capacity(self, capacity: int) -> None:
+        """Live budget change (dfs.datanode.max.locked.memory is one of
+        the reference's reconfigurable keys); shrink evicts nothing —
+        pins just stop until usage drains below the new cap."""
+        with self._lock:
+            self._capacity = capacity
+
     def pin(self, block_id: int, data: bytes) -> bool:
         with self._lock:
             if block_id in self._data:
@@ -418,6 +425,11 @@ class DataNode:
                                   "gen_stamp": meta.gen_stamp if meta else -1,
                                   "rbw": self.replicas.is_rbw(
                                       fields["block_id"])})
+            elif op == "reconfigure":
+                send_frame(sock, self.reconfigure(fields.get("key", ""),
+                                                  fields.get("value")))
+            elif op == "get_reconfigurable":
+                send_frame(sock, {"keys": sorted(self.RECONFIGURABLE)})
             elif op == "disk_balance":
                 # intra-DN volume evening (diskbalancer -plan/-execute in
                 # one round trip; like the DN protocol, trusted within the
@@ -692,6 +704,49 @@ class DataNode:
                 continue  # standby / raced recovery: another NN may accept
         _M.incr("block_recovery_failures")
 
+    # Live reconfiguration (ReconfigurationProtocol.proto /
+    # TestDataNodeReconfiguration analog): a whitelist of keys applied
+    # without a restart.  Loops read config each tick, so interval
+    # changes take effect at the next wakeup.
+    RECONFIGURABLE = frozenset({
+        "scan_interval_s", "volume_check_interval_s",
+        "block_report_interval_s", "cache_capacity",
+    })
+
+    def reconfigure(self, key: str, value) -> dict:
+        if key not in self.RECONFIGURABLE:
+            return {"ok": False,
+                    "error": f"'{key}' is not reconfigurable "
+                             f"(allowed: {sorted(self.RECONFIGURABLE)})"}
+        old = getattr(self.config, key)
+        try:
+            cast = type(old)(value)
+        except (TypeError, ValueError) as e:
+            return {"ok": False, "error": f"bad value for {key}: {e}"}
+        if key.endswith("_interval_s"):
+            # the loops wait() on these each tick: 0/negative would turn
+            # them into busy-spins, and a loop that was DISABLED at start
+            # (interval 0) was never spawned — a new interval could not
+            # take effect and must not pretend to
+            if cast <= 0:
+                return {"ok": False,
+                        "error": f"{key} must be > 0 (disabling a loop "
+                                 "requires a restart)"}
+            thread_of = {"scan_interval_s": "-scanner",
+                         "volume_check_interval_s": "-volcheck"}
+            suffix = thread_of.get(key)
+            if suffix is not None and not any(
+                    t.name.endswith(suffix) and t.is_alive()
+                    for t in self._threads):
+                return {"ok": False,
+                        "error": f"{key}: that loop was disabled at "
+                                 "startup and is not running"}
+        setattr(self.config, key, cast)
+        if key == "cache_capacity":
+            self.cache.set_capacity(int(cast))
+        _M.incr("reconfigurations")
+        return {"ok": True, "key": key, "old": old, "new": cast}
+
     def _verify_index_containers(self) -> list[int]:
         """Startup cross-check: with ``fsync_containers=False`` an OS crash
         can leave the (always-fsync'd) chunk index referencing container
@@ -862,9 +917,9 @@ class DataNode:
         finalized replicas at a throttled rate; corrupt replicas are reported
         to the NN (markBlockAsCorrupt path) which drops the location and lets
         the redundancy monitor re-replicate from a good copy."""
-        interval = self.config.scan_interval_s
         cursor = 0
-        while not self._stop.wait(interval):
+        # interval re-read each tick: scan_interval_s is live-reconfigurable
+        while not self._stop.wait(self.config.scan_interval_s):
             try:
                 bids = sorted(self.replicas.block_ids())
                 if not bids:

@@ -194,7 +194,31 @@ def cmd_dfs(args) -> int:
 
 # ------------------------------------------------------------------ dfsadmin
 
+def _dn_call(addr: str, op: str, timeout: float = 30.0, **fields) -> dict:
+    """One data-plane op against a DataNode ('host:port') — the direct
+    path dfsadmin -reconfig and diskbalancer share."""
+    import socket as _socket
+
+    from hdrf_tpu.proto import datatransfer as dt
+    from hdrf_tpu.proto.rpc import recv_frame
+
+    host, port = addr.rsplit(":", 1)
+    with _socket.create_connection((host, int(port)), timeout=timeout) as s:
+        dt.send_op(s, op, **fields)
+        return recv_frame(s)
+
+
 def cmd_dfsadmin(args) -> int:
+    if args.op == "-reconfig":
+        # DataNode-direct (ReconfigurationProtocol analog): no NN
+        # round trip — reconfiguring a DN must work while the NN is down
+        if args.args[1] == "list":
+            print(json.dumps(_dn_call(args.args[0], "get_reconfigurable")))
+        else:
+            print(json.dumps(_dn_call(args.args[0], "reconfigure",
+                                      key=args.args[1],
+                                      value=args.args[2])))
+        return 0
     with _client(args) as c:
         if args.op == "-report":
             for d in c.datanode_report():
@@ -377,15 +401,8 @@ def cmd_balancer(args) -> int:
 def cmd_diskbalancer(args) -> int:
     """DiskBalancer-lite (server/diskbalancer analog): ask a DataNode to
     even its own volumes — plan + execute in one round trip."""
-    import socket as _socket
-
-    from hdrf_tpu.proto import datatransfer as dt
-    from hdrf_tpu.proto.rpc import recv_frame
-
-    host, port = args.datanode.rsplit(":", 1)
-    with _socket.create_connection((host, int(port)), timeout=60) as s:
-        dt.send_op(s, "disk_balance", threshold=args.threshold)
-        r = recv_frame(s)
+    r = _dn_call(args.datanode, "disk_balance", timeout=60.0,
+                 threshold=args.threshold)
     print(json.dumps(r, indent=2))
     return 0
 
