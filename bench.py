@@ -277,7 +277,11 @@ def main() -> None:
         # ------------------------------------------------ full path (e2e)
         lz4 = TpuLz4()
 
-        SEAL_GROUP = 4  # containers per grouped scan (one readback each)
+        SEAL_GROUP = 2  # containers per grouped scan (one readback each);
+        # 2 beats 4 measured: scans dispatch after every SECOND rollover,
+        # so device compute starts ~2x earlier in the commit phase and
+        # the extra readback RTTs hide under commit work (e2e 1.23->1.27,
+        # tg 1.23->1.37 median paired)
         DEBUG = os.environ.get("HDRF_BENCH_DEBUG") == "1"
 
         def _dbg(tag, label, t0):
