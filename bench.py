@@ -277,11 +277,11 @@ def main() -> None:
         # ------------------------------------------------ full path (e2e)
         lz4 = TpuLz4()
 
-        SEAL_GROUP = 2  # containers per grouped scan (one readback each);
-        # 2 beats 4 measured: scans dispatch after every SECOND rollover,
-        # so device compute starts ~2x earlier in the commit phase and
-        # the extra readback RTTs hide under commit work (e2e 1.23->1.27,
-        # tg 1.23->1.37 median paired)
+        SEAL_GROUP = 1  # containers per scan dispatch: every rollover
+        # dispatches immediately.  Monotone win measured across 4 -> 2 ->
+        # 1 (TPU e2e 66 -> 71 -> 79 MB/s, TeraGen 139 -> 156 -> 163):
+        # the earlier the device starts, the more compute hides under the
+        # commit phase, and the per-dispatch RTTs hide under commit work
         DEBUG = os.environ.get("HDRF_BENCH_DEBUG") == "1"
 
         def _dbg(tag, label, t0):
