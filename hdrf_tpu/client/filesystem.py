@@ -317,6 +317,22 @@ class HdrfClient:
 
     # ----------------------------------------------------------------- write
 
+    def open_for_write(self, path: str,
+                       replication: int | None = None) -> "HdrfOutputStream":
+        """Open a streaming writer with hflush/hsync support
+        (DFSOutputStream.java:573 hflush / :580 hsync — the mid-write
+        durability API WAL-shaped workloads depend on).  Blocks written
+        through the stream are stored under the ``direct`` scheme: bytes
+        must reach replicas incrementally, which is incompatible with
+        whole-block reduction (the reference likewise reduces only blocks
+        that arrive whole)."""
+        info = self._call("create", path=path, client=self.name,
+                          replication=replication, scheme="direct")
+        if info.get("encryption"):
+            raise IOError("streaming writes inside encryption zones are "
+                          "not supported (use write())")
+        return HdrfOutputStream(self, path, info["block_size"])
+
     def write(self, path: str, data: bytes, scheme: str | None = None,
               replication: int | None = None, ec: str | None = None) -> None:
         """Write a whole file (the put path, §3.1 of SURVEY.md).  ``ec`` is an
@@ -559,3 +575,198 @@ class HdrfClient:
             return data
         finally:
             sock.close()
+
+
+class HdrfOutputStream:
+    """Streaming output with mid-write durability (DFSOutputStream analog).
+
+    ``write`` buffers; a full block's bytes stream down one pipeline socket
+    held open across calls (DataStreamer's block lifetime).  ``hflush``
+    pushes the buffered bytes as packets whose final one carries FLAG_FLUSH
+    — every pipeline DN exposes the prefix to readers before acking — then
+    persists the visible length at the NameNode (ClientProtocol.fsync), so
+    a NEW reader sees every hflush'd byte (DFSOutputStream.java:573).
+    ``hsync`` flags FLAG_SYNC instead: DNs also fsync the partial replica,
+    so the prefix survives a DataNode crash (:580).
+
+    Pipeline failure before any flush in the current block retries
+    block-granularly (abandon + re-request, as HdrfClient.write does); after
+    a flush the block's bytes are already reader-visible, so the error
+    propagates — the caller's recovery is recover_lease + reopen, matching
+    the reference's semantics when pipeline recovery exhausts datanodes."""
+
+    def __init__(self, client: HdrfClient, path: str, block_size: int):
+        self._c = client
+        self._path = path
+        self._bs = block_size
+        self._buf = bytearray()        # bytes not yet sent down the pipeline
+        self._block = bytearray()      # ALL bytes of the current block (retry)
+        self._lengths: dict[int, int] = {}
+        self._sock = None
+        self._alloc: dict | None = None
+        self._seqno = 0
+        self._flushed_in_block = False
+        self._closed = False
+        import time as _t
+        self._last_renew = _t.monotonic()
+
+    # ------------------------------------------------------------- pipeline
+
+    def _open_pipeline(self) -> None:
+        alloc = self._c._call("add_block", path=self._path,
+                              client=self._c.name)
+        targets = alloc["targets"]
+        sock = socket.create_connection(tuple(targets[0]["addr"]),
+                                        timeout=120)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock = dt.secure_socket(sock, alloc.get("token"),
+                                self._c.config.encrypt_data_transfer)
+        dt.send_op(sock, dt.WRITE_BLOCK, block_id=alloc["block_id"],
+                   gen_stamp=alloc["gen_stamp"], scheme="direct",
+                   token=alloc.get("token"), targets=targets[1:],
+                   storage_type=targets[0].get("storage_type"))
+        self._sock, self._alloc, self._seqno = sock, alloc, 0
+
+    def _teardown(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+        self._sock = self._alloc = None
+        self._seqno = 0
+        self._flushed_in_block = False
+
+    def _send(self, flags: int = 0, last: bool = False) -> None:
+        """Packetize the unsent buffer; ``flags`` ride the FINAL packet of
+        the batch (the flush barrier), ``last`` ends the block.  Drains one
+        ack per packet sent — the final ack carries aggregated downstream
+        status."""
+        if self._sock is None:
+            self._open_pipeline()
+        psz = self._c.config.packet_size
+        pkts: list[bytes] = [bytes(self._buf[i:i + psz])
+                             for i in range(0, len(self._buf), psz)]
+        if last:
+            pkts.append(b"")           # empty LAST trailer
+        elif flags and not pkts:
+            pkts.append(b"")           # pure flush marker, no new bytes
+        if not pkts:
+            return
+        del self._buf[:]
+        sent = 0
+        status = dt.ACK_SUCCESS
+        for i, p in enumerate(pkts):
+            fin = i == len(pkts) - 1
+            dt.write_packet(self._sock, self._seqno, p,
+                            last=last and fin,
+                            flags=flags if fin and not last else 0)
+            self._seqno += 1
+            sent += 1
+        for _ in range(sent):
+            _, st = dt.read_ack(self._sock)
+            status = max(status, st)
+        if status != dt.ACK_SUCCESS:
+            raise IOError(f"pipeline returned status {status}")
+
+    def _finish_block(self) -> None:
+        """End the current block: empty LAST packet, final aggregated ack,
+        record its length."""
+        if self._sock is None and not self._block:
+            return
+        self._send(last=True)
+        bid = self._alloc["block_id"]
+        self._lengths[bid] = len(self._block)
+        self._last_finished = (bid, len(self._block))
+        self._sock.close()
+        self._sock = self._alloc = None
+        self._seqno = 0
+        del self._block[:]
+        self._flushed_in_block = False
+
+    def _retryable(self, op) -> None:
+        """Run a pipeline op; on connection failure with no flush exposure
+        in this block, abandon and replay the whole current block on a
+        fresh pipeline (block-granular recovery)."""
+        try:
+            op()
+            return
+        except (OSError, ConnectionError, IOError):
+            if self._flushed_in_block:
+                raise
+            _M.incr("block_write_retries")
+            bid = self._alloc["block_id"] if self._alloc else None
+            self._teardown()
+            if bid is not None:
+                self._c._call("abandon_block", path=self._path,
+                              client=self._c.name, block_id=bid)
+        self._buf = bytearray(self._block)   # replay from block start
+        op()
+
+    # ------------------------------------------------------------------ api
+
+    def write(self, data: bytes) -> None:
+        assert not self._closed, "stream closed"
+        import time as _t
+
+        if _t.monotonic() - self._last_renew > 20.0:
+            self._c._call("renew_lease", client=self._c.name)
+            self._last_renew = _t.monotonic()
+        off = 0
+        while off < len(data):
+            room = self._bs - len(self._block)
+            take = data[off:off + min(room, len(data) - off)]
+            self._buf += take
+            self._block += take
+            off += len(take)
+            if len(self._block) >= self._bs:
+                self._retryable(self._finish_block)
+                # Persist the finished block's length while the file stays
+                # open (the reference commits the previous block's length
+                # in the next addBlock call) — without it a reader of the
+                # open file sees length 0 for this block until complete().
+                # OUTSIDE the retry wrapper: the block is already finalized
+                # on every DN, and a replay here would allocate a duplicate.
+                bid, ln = self._last_finished
+                self._c._call("fsync", path=self._path, client=self._c.name,
+                              block_id=bid, length=ln)
+
+    def hflush(self, sync: bool = False) -> None:
+        """Push buffered bytes to every pipeline DN and make them visible
+        to new readers; ``sync=True`` (= hsync) also fsyncs each replica."""
+        assert not self._closed, "stream closed"
+        if not self._block and not self._buf:
+            return  # nothing in the current block; prior blocks are final
+        flag = dt.FLAG_SYNC if sync else dt.FLAG_FLUSH
+        self._retryable(lambda: self._send(flags=flag))
+        self._flushed_in_block = True
+        self._c._call("fsync", path=self._path, client=self._c.name,
+                      block_id=self._alloc["block_id"],
+                      length=len(self._block))
+        _M.incr("hsyncs" if sync else "hflushes")
+
+    def hsync(self) -> None:
+        self.hflush(sync=True)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        if self._block or self._buf or self._sock is not None:
+            self._retryable(self._finish_block)
+        self._c._complete(self._path, self._lengths)
+        self._closed = True
+        _M.incr("files_written")
+
+    def abort(self) -> None:
+        """Tear the stream down without completing the file: the pipeline
+        socket closes (the DN persists the acked prefix as a partial
+        replica) and the dangling lease is left for lease recovery — the
+        DFSOutputStream.abort analog."""
+        self._teardown()
+        self._closed = True
+
+    def __enter__(self) -> "HdrfOutputStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if exc[0] is None:
+            self.close()
+        else:
+            self.abort()

@@ -36,6 +36,12 @@ from hdrf_tpu.utils import tracing
 
 PKT_HDR = struct.Struct("<IQBI")
 FLAG_LAST = 0x1
+# hflush/hsync markers (DFSOutputStream.java:573 hflush / :580 hsync; the
+# reference rides syncBlock on the packet header, PacketHeader.java): a
+# FLUSH-flagged packet makes the receiver expose the prefix to readers
+# (visible length) before acking; SYNC additionally fsyncs the replica.
+FLAG_FLUSH = 0x2
+FLAG_SYNC = 0x4
 
 ACK = struct.Struct("<QB")
 ACK_SUCCESS = 0
@@ -78,20 +84,25 @@ def recv_op(sock: socket.socket) -> tuple[str, dict]:
 
 
 def write_packet(sock: socket.socket, seqno: int, data: bytes,
-                 last: bool = False) -> None:
-    flags = FLAG_LAST if last else 0
+                 last: bool = False, flags: int = 0) -> None:
+    flags |= FLAG_LAST if last else 0
     sock.sendall(PKT_HDR.pack(len(data), seqno, flags, native.crc32c(data)))
     if data:
         sock.sendall(data)
 
 
-def read_packet(sock: socket.socket) -> tuple[int, bytes, bool]:
-    """Returns (seqno, data, last); raises IOError on checksum mismatch —
+def read_packet_ex(sock: socket.socket) -> tuple[int, bytes, int]:
+    """Returns (seqno, data, flags); raises IOError on checksum mismatch —
     the receiver-side verify the reference does per checksum chunk."""
     ln, seqno, flags, crc = PKT_HDR.unpack(recv_exact(sock, PKT_HDR.size))
     data = recv_exact(sock, ln) if ln else b""
     if native.crc32c(data) != crc:
         raise IOError(f"packet {seqno}: checksum mismatch")
+    return seqno, data, flags
+
+
+def read_packet(sock: socket.socket) -> tuple[int, bytes, bool]:
+    seqno, data, flags = read_packet_ex(sock)
     return seqno, data, bool(flags & FLAG_LAST)
 
 
@@ -100,6 +111,16 @@ def iter_packets(sock: socket.socket) -> Iterator[tuple[int, bytes, bool]]:
         seqno, data, last = read_packet(sock)
         yield seqno, data, last
         if last:
+            return
+
+
+def iter_packets_ex(sock: socket.socket) -> Iterator[tuple[int, bytes, int]]:
+    """Flag-preserving packet run iterator (the write path needs FLUSH/SYNC
+    markers; readers of whole runs use iter_packets)."""
+    while True:
+        seqno, data, flags = read_packet_ex(sock)
+        yield seqno, data, flags
+        if flags & FLAG_LAST:
             return
 
 

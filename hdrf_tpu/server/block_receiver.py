@@ -104,14 +104,17 @@ class BlockReceiver:
                 tail = b""
                 cchunk = dn.checksum_chunk
                 forwarded = 0
+                drained = 0   # mirror acks consumed by flush barriers
                 fwd_bytes = 0
                 mirror_t = 0.0  # downstream-only time (write + ack drain)
-                for seqno, data, last in dt.iter_packets(sock):
+                for seqno, data, flags in dt.iter_packets_ex(sock):
+                    last = bool(flags & dt.FLAG_LAST)
                     fault_injection.point("block_receiver.packet",
                                           block_id=block_id, seqno=seqno)
                     if mirror_sock is not None:
                         _mt0 = time.perf_counter()
-                        dt.write_packet(mirror_sock, seqno, data, last)
+                        dt.write_packet(mirror_sock, seqno, data,
+                                        flags=flags)
                         mirror_t += time.perf_counter() - _mt0
                         forwarded += 1
                         fwd_bytes += len(data)
@@ -121,7 +124,26 @@ class BlockReceiver:
                         while len(tail) >= cchunk:
                             crcs.append(native.crc32c(tail[:cchunk]))
                             tail = tail[cchunk:]
-                    if not last:
+                    if not last and flags & (dt.FLAG_FLUSH | dt.FLAG_SYNC):
+                        # hflush/hsync barrier: every downstream node must
+                        # have processed the prefix before we ack (the
+                        # PipelineAck semantics hflush depends on) — drain
+                        # the mirror's acks up to this packet, then expose
+                        # the visible length (+fsync for hsync) locally.
+                        status = dt.ACK_SUCCESS
+                        if mirror_sock is not None:
+                            _mt0 = time.perf_counter()
+                            while drained < forwarded:
+                                _, down = dt.read_ack(mirror_sock)
+                                status = max(status, down)
+                                drained += 1
+                            mirror_t += time.perf_counter() - _mt0
+                        vis_crcs = crcs + ([native.crc32c(tail)]
+                                           if tail else [])
+                        writer.flush_visible(vis_crcs, cchunk,
+                                             sync=bool(flags & dt.FLAG_SYNC))
+                        dt.send_ack(sock, seqno, status)
+                    elif not last:
                         dt.send_ack(sock, seqno)
                     else:
                         if tail:
@@ -132,7 +154,7 @@ class BlockReceiver:
                             # the final one carries the aggregated downstream
                             # status — earlier ones are flow control.
                             _mt0 = time.perf_counter()
-                            for _ in range(forwarded):
+                            for _ in range(forwarded - drained):
                                 _, down = dt.read_ack(mirror_sock)
                                 status = max(status, down)
                             mirror_t += time.perf_counter() - _mt0

@@ -504,6 +504,13 @@ class NameNode:
                 keep.append(bid)
                 pos += ln
             node.blocks = keep
+        elif op == "fsync":
+            # hflush/hsync visible-length persist (FSNamesystem.fsync):
+            # only ever grows — a lagging retry must not shrink it
+            _, _path, bid, ln = rec
+            finfo = self._blocks.get(bid)
+            if finfo is not None and ln > finfo.length:
+                finfo.length = ln
         elif op == "complete":
             _, path, lengths, mtime = rec
             node = self._file(path)
@@ -657,10 +664,13 @@ class NameNode:
             for path in [rec[1], *rec[2]]:
                 for r, _ in self._quota_roots_of(path):
                     self._qusage[r] = None
-        elif op in ("delete", "rename", "delete_snapshot", "truncate"):
+        elif op in ("delete", "rename", "delete_snapshot", "truncate",
+                    "fsync"):
             # truncate included: it SHRINKS usage (dropped whole blocks +
             # the cut boundary block), which the incremental paths never
-            # subtract — a stale high value would falsely reject writes
+            # subtract — a stale high value would falsely reject writes.
+            # fsync included: it sets a UC block length early, which would
+            # skew complete's incremental delta — recount lazily instead
             for path in (rec[1], rec[2] if op == "rename" else rec[1]):
                 if isinstance(path, str):
                     for r, _ in self._quota_roots_of(path):
@@ -844,7 +854,7 @@ class NameNode:
         elif op == "create":
             self._peek_parent(rec[1])
         elif op in ("add_block", "add_block_group", "abandon_block",
-                    "complete"):
+                    "complete", "fsync"):
             self._file(rec[1])
         elif op == "delete":
             self._parent_of(rec[1])
@@ -1493,6 +1503,31 @@ class NameNode:
             self._leases.renew_all(client)
             return True
 
+    def rpc_fsync(self, path: str, client: str, block_id: int,
+                  length: int) -> bool:
+        """Persist the visible length of an under-construction block after a
+        client hflush/hsync (ClientProtocol.fsync, FSNamesystem.fsync:
+        updateBlockForPipeline's length persist) — a reader calling
+        get_block_locations from now on sees the flushed bytes.  Length can
+        only grow (a lagging retry must not shrink a longer flush)."""
+        with self._lock:
+            self._file(path)
+            self._leases.check(path, client)
+            info = self._blocks.get(block_id)
+            if info is None:
+                raise KeyError(f"block {block_id} is not allocated")
+            if info.path != "/" + "/".join(self._parts(path)):
+                # the lease only covers the caller's own file: without this
+                # check a writer could inflate ANY under-construction
+                # block's recorded length in the namespace
+                raise PermissionError(
+                    f"block {block_id} does not belong to {path}")
+            if length > info.length:
+                self._log(["fsync", path, block_id, length])
+            self._leases.renew_all(client)
+            _M.incr("fsyncs")
+            return True
+
     def rpc_get_block_locations(self, path: str) -> dict:
         with self._lock:
             self._check_access(path, want=perm.READ)
@@ -1517,11 +1552,21 @@ class NameNode:
             blocks = []
             for bid in node.blocks:
                 info = self._blocks[bid]
+                locs = self._locs_of(bid)
+                if not locs and not node.complete and info.length > 0:
+                    # under-construction block with an hflush'd visible
+                    # length: no replica has finalized yet, so serve the
+                    # intended pipeline DNs (the reference returns the UC
+                    # block's expected locations to readers of open files)
+                    locs = [{"dn_id": d,
+                             "addr": list(self._datanodes[d].addr),
+                             "sc_path": self._datanodes[d].sc_path}
+                            for d in info.expected if d in self._datanodes]
                 blocks.append({"block_id": bid, "gen_stamp": info.gen_stamp,
                                "length": info.length,
                                "token": (self._tokens.mint(bid, "r")
                                          if self._tokens else None),
-                               "locations": self._locs_of(bid)})
+                               "locations": locs})
             enc = None
             if self._EZ_XATTR in node.attrs.xattrs:
                 # FileEncryptionInfo-in-LocatedBlocks: the decrypted DEK
@@ -2297,6 +2342,7 @@ class NameNode:
                                 dn.commands.append({"cmd": "invalidate",
                                                     "block_ids": [bid]})
                             info.reported.pop(dn_id, None)
+                            info.storage_of.pop(dn_id, None)
                             info.locations.discard(dn_id)
                         else:
                             reported.add(bid)
@@ -2327,12 +2373,14 @@ class NameNode:
                         dn.commands.append({"cmd": "invalidate",
                                             "block_ids": [bid]})
                     info.reported.pop(dn_id, None)
+                    info.storage_of.pop(dn_id, None)
                     info.locations.discard(dn_id)
             for bid in dn.blocks - reported:
                 info = self._blocks.get(bid)
                 if info:
                     info.locations.discard(dn_id)
                     info.reported.pop(dn_id, None)
+                    info.storage_of.pop(dn_id, None)
             dn.blocks = reported
             _M.incr("block_reports")
             return True
@@ -2502,6 +2550,7 @@ class NameNode:
                 return False
             info.locations.discard(dn_id)
             info.reported.pop(dn_id, None)
+            info.storage_of.pop(dn_id, None)
             if dn is not None:
                 dn.blocks.discard(block_id)
             self._pending_repl.pop(block_id, None)  # reschedule immediately
@@ -3080,6 +3129,7 @@ class NameNode:
                         if info:
                             info.locations.discard(dn.dn_id)
                             info.reported.pop(dn.dn_id, None)
+                            info.storage_of.pop(dn.dn_id, None)
                     del self._datanodes[dn.dn_id]
 
     def _check_replication(self) -> None:

@@ -299,7 +299,12 @@ class ShortCircuitServer:
                     "physical_len": meta.physical_len,
                     "checksum_chunk": meta.checksum_chunk,
                     "checksums": meta.checksums,
-                    "fd": meta.scheme == "direct" and meta.physical_len > 0}
+                    # never pass an fd for an in-flight (hflush-visible)
+                    # replica: its rbw file is still growing and the
+                    # granted checksums would go stale — network reads
+                    # serve the visible prefix instead
+                    "fd": (meta.scheme == "direct" and meta.physical_len > 0
+                           and not self._dn.replicas.is_rbw(block_id))}
             if resp["fd"] and "shm_id" in req:
                 # revocable grant: the slot index + generation the client
                 # must check before every cached-fd read

@@ -85,6 +85,30 @@ class ReplicaWriter:
     def bytes_written(self) -> int:
         return self._written
 
+    def flush_visible(self, checksums: list[int],
+                      checksum_chunk: int = 64 * 1024,
+                      sync: bool = False) -> None:
+        """hflush/hsync support (DFSOutputStream.java:573/:580): expose the
+        bytes written so far to concurrent readers — the reference's RBW
+        visible length (ReplicaInPipeline.setBytesAcked).  ``sync`` also
+        fsyncs data + sidecar so the prefix survives a DataNode crash
+        (the replica is then promoted to finalized on restart)."""
+        self._fh.flush()
+        if sync:
+            os.fsync(self._fh.fileno())
+        meta = BlockMeta(self.block_id, self.gen_stamp, self._written,
+                         self._written, "direct", checksum_chunk,
+                         list(checksums))
+        mp = self._path + ".meta"
+        with open(mp + ".tmp", "wb") as f:
+            f.write(meta.pack())
+            if sync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(mp + ".tmp", mp)
+        self._store._set_visible(meta)
+        _M.incr("hsyncs" if sync else "hflushes")
+
     def finalize(self, logical_len: int, scheme: str,
                  checksums: list[int] | None = None,
                  checksum_chunk: int = 64 * 1024) -> BlockMeta:
@@ -99,6 +123,8 @@ class ReplicaWriter:
                          self._written, scheme, checksum_chunk, checksums or [])
         dst = self._store._path(FINALIZED, self.block_id)
         os.replace(self._path, dst)
+        if os.path.exists(self._path + ".meta"):
+            os.unlink(self._path + ".meta")  # rbw-visible sidecar superseded
         # write-replace, never open("wb") the existing meta: on a supersede
         # rewrite (append/recovery finalize) the old meta may be hardlinked
         # into an upgrade snapshot (storage/version.py), and truncating the
@@ -114,8 +140,9 @@ class ReplicaWriter:
 
     def abort(self) -> None:
         self._fh.close()
-        if os.path.exists(self._path):
-            os.unlink(self._path)
+        for p in (self._path, self._path + ".meta"):
+            if os.path.exists(p):
+                os.unlink(p)
         self._store._release_rbw(self.block_id)
 
 
@@ -127,14 +154,21 @@ class ReplicaStore:
         self._lock = threading.Lock()
         self._replicas: dict[int, BlockMeta] = {}
         self._rbw: set[int] = set()  # block ids with an open writer
+        # hflush'd in-flight replicas: block_id -> meta with the VISIBLE
+        # length (bytes a concurrent reader may see; ReplicaInPipeline
+        # .getVisibleLength analog)
+        self._visible: dict[int, BlockMeta] = {}
         self._recover()
 
     def _path(self, state: str, block_id: int) -> str:
         return os.path.join(self._dir, state, f"blk_{block_id}")
 
     def _recover(self) -> None:
-        """Load finalized replicas from disk; drop orphaned RBW files (crash
-        mid-write — the client's pipeline recovery re-writes the block)."""
+        """Load finalized replicas from disk; RBW files with an hflush
+        sidecar are PROMOTED to finalized at their last synced visible
+        length (the reference recovers RBW as RWR with its on-disk bytes,
+        FsDatasetImpl.recoverRbw); orphaned RBW files without one are
+        dropped (crash mid-write — pipeline recovery re-writes the block)."""
         fdir = os.path.join(self._dir, FINALIZED)
         for name in os.listdir(fdir):
             if name.endswith(".meta"):
@@ -143,6 +177,52 @@ class ReplicaStore:
                 self._replicas[meta.block_id] = meta
         rdir = os.path.join(self._dir, RBW)
         for name in os.listdir(rdir):
+            p = os.path.join(rdir, name)
+            if name.endswith(".meta") or name.endswith(".tmp"):
+                continue
+            mp = p + ".meta"
+            if not os.path.exists(mp):
+                os.unlink(p)
+                continue
+            try:
+                with open(mp, "rb") as f:
+                    meta = BlockMeta.unpack(f.read())
+            except Exception:  # noqa: BLE001 — torn sidecar (power loss
+                # between rename and data reaching disk): drop the pair
+                # rather than crash-loop the DataNode on startup
+                os.unlink(p)
+                os.unlink(mp)
+                _M.incr("rbw_sidecar_torn")
+                continue
+            if meta.block_id in self._replicas:   # superseded already
+                os.unlink(p)
+                os.unlink(mp)
+                continue
+            # cut unsynced bytes beyond the last visible length, then
+            # finalize in place
+            size = os.path.getsize(p)
+            if size > meta.physical_len:
+                with open(p, "r+b") as f:
+                    f.truncate(meta.physical_len)
+            elif size < meta.physical_len:
+                # crash lost the tail of a non-synced flush: keep the bytes
+                # that ARE there, re-deriving the final chunk's checksum
+                meta.logical_len = meta.physical_len = size
+                nchunks = -(-size // meta.checksum_chunk) if size else 0
+                del meta.checksums[nchunks:]
+                if meta.checksums:
+                    from hdrf_tpu import native
+                    with open(p, "rb") as f:
+                        f.seek((nchunks - 1) * meta.checksum_chunk)
+                        meta.checksums[-1] = native.crc32c(f.read())
+                with open(mp, "wb") as f:
+                    f.write(meta.pack())
+            dst = self._path(FINALIZED, meta.block_id)
+            os.replace(p, dst)
+            os.replace(mp, dst + ".meta")
+            self._replicas[meta.block_id] = meta
+            _M.incr("rbw_promoted")
+        for name in os.listdir(rdir):             # leftover sidecars/tmps
             os.unlink(os.path.join(rdir, name))
 
     # -------------------------------------------------------------- lifecycle
@@ -172,14 +252,24 @@ class ReplicaStore:
         with self._lock:
             self._replicas[meta.block_id] = meta
             self._rbw.discard(meta.block_id)
+            self._visible.pop(meta.block_id, None)
 
     def _release_rbw(self, block_id: int) -> None:
         with self._lock:
             self._rbw.discard(block_id)
+            self._visible.pop(block_id, None)
+
+    def _set_visible(self, meta: BlockMeta) -> None:
+        with self._lock:
+            self._visible[meta.block_id] = meta
 
     def get_meta(self, block_id: int) -> BlockMeta | None:
+        """Finalized meta, or the hflush'd visible meta for a block still
+        in the write pipeline — which is what lets a concurrent reader see
+        flushed bytes (BlockSender serves through this)."""
         with self._lock:
-            return self._replicas.get(block_id)
+            return (self._replicas.get(block_id)
+                    or self._visible.get(block_id))
 
     def is_rbw(self, block_id: int) -> bool:
         """An open in-flight writer exists (replica-being-written): block
@@ -197,7 +287,22 @@ class ReplicaStore:
         return meta.logical_len
 
     def read_data(self, block_id: int, offset: int = 0, length: int = -1) -> bytes:
-        """Raw stored bytes of the replica data file (post-reduction form)."""
+        """Raw stored bytes of the replica data file (post-reduction form).
+        An hflush'd in-flight replica serves its VISIBLE prefix from the
+        rbw file (clamped — the writer may be ahead of the last flush)."""
+        with self._lock:
+            vis = (None if block_id in self._replicas
+                   else self._visible.get(block_id))
+        if vis is not None:
+            end = vis.physical_len if length < 0 \
+                else min(offset + length, vis.physical_len)
+            try:
+                with open(self._path(RBW, block_id), "rb") as f:
+                    f.seek(offset)
+                    return f.read(max(end - offset, 0))
+            except FileNotFoundError:
+                pass  # finalize() raced us (os.replace happens before the
+                # meta registers): the finalized file below has the bytes
         p = self._path(FINALIZED, block_id)
         with open(p, "rb") as f:
             f.seek(offset)

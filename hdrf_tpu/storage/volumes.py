@@ -109,7 +109,12 @@ class VolumeSet:
             # reconfigured with FEWER volumes must degrade (block treated
             # as lost -> re-replicated), not crash on the stale namespace
             raise IOError(f"container {cid}: volume {vid} not configured")
-        return self.volumes[vid]
+        v = self.volumes[vid]
+        if v.failed:
+            # an ejected volume's bytes may be corrupt — refuse loudly so
+            # the read path degrades to "chunk lost" instead of serving them
+            raise IOError(f"container {cid}: volume {vid} is ejected")
+        return v
 
     # ----------------------------------------------------- replica surface
 
@@ -317,8 +322,10 @@ class MultiContainerStore:
             by_vol.setdefault(cid >> CID_SHIFT, []).append(i)
         out = [None] * len(locs)
         for vid, idxs in by_vol.items():
-            got = self._vs.volumes[vid].containers.read_chunks(
-                [locs[i] for i in idxs])
+            # route through volume_of_cid so stale cid namespaces and
+            # ejected volumes raise IOError (treat-as-lost), not IndexError
+            vol = self._vs.volume_of_cid(vid << CID_SHIFT)
+            got = vol.containers.read_chunks([locs[i] for i in idxs])
             for i, b in zip(idxs, got):
                 out[i] = b
         return out

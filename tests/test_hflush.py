@@ -1,0 +1,121 @@
+"""hflush/hsync: mid-write durability + reader visibility.
+
+The reference API under test: DFSOutputStream.java:573 (hflush — pushed to
+every pipeline DN, visible to new readers), :580 (hsync — additionally
+fsync'd on each DN).  The HBase/WAL contract: bytes a writer flushed must be
+readable by a concurrent reader while the file stays open, and hsync'd
+bytes must survive a DataNode crash.
+"""
+
+import os
+
+import pytest
+
+from hdrf_tpu.testing.minicluster import MiniCluster
+
+
+@pytest.fixture()
+def cluster():
+    with MiniCluster(n_datanodes=2, replication=2,
+                     block_size=256 * 1024) as mc:
+        yield mc
+
+
+def test_hflush_visible_to_concurrent_reader(cluster):
+    data1 = os.urandom(100_000)   # partial checksum chunk on purpose
+    data2 = os.urandom(50_000)
+    with cluster.client("writer") as w, cluster.client("reader") as r:
+        out = w.open_for_write("/wal")
+        out.write(data1)
+        out.hflush()
+        # a NEW reader sees every flushed byte while the file is open
+        assert r.read("/wal") == data1
+        out.write(data2)
+        out.hflush()
+        assert r.read("/wal") == data1 + data2
+        # range read of the open file
+        assert r.read("/wal", offset=90_000, length=20_000) == \
+            (data1 + data2)[90_000:110_000]
+        out.close()
+        assert r.read("/wal") == data1 + data2
+        assert r.stat("/wal")["complete"]
+
+
+def test_hflush_without_close_reader_gets_flushed_bytes(cluster):
+    """Writer dies (never closes): flushed bytes stay readable."""
+    data = os.urandom(64_000)
+    w = cluster.client("dying-writer")
+    out = w.open_for_write("/wal2")
+    out.write(data)
+    out.hflush()
+    w.close()                      # client gone, no close(), lease dangling
+    with cluster.client("reader") as r:
+        assert r.read("/wal2") == data
+
+
+def test_hflush_across_block_boundary(cluster):
+    """Flush after the stream has rolled to a second block: the finished
+    block's length is persisted too, so a reader sees the whole prefix."""
+    bs = 256 * 1024
+    data = os.urandom(bs + 70_000)
+    with cluster.client("w") as w, cluster.client("r") as r:
+        out = w.open_for_write("/multi")
+        out.write(data)
+        out.hflush()
+        assert r.read("/multi") == data
+        out.close()
+        assert r.read("/multi") == data
+
+
+def test_hsync_survives_datanode_crash():
+    """hsync -> kill the DN (abrupt) -> restart over the same dir: the
+    synced prefix is promoted to a finalized replica and served."""
+    with MiniCluster(n_datanodes=1, replication=1,
+                     block_size=256 * 1024) as mc:
+        data = os.urandom(90_000)
+        w = mc.client("writer")
+        out = w.open_for_write("/synced")
+        out.write(data)
+        out.hsync()
+        w.close()
+        mc.kill_datanode(0)
+        mc.restart_datanode(0)
+        mc.wait_for_datanodes(1)
+        with mc.client("reader") as r:
+            assert r.read("/synced") == data
+
+
+def test_unflushed_tail_not_visible(cluster):
+    """Bytes written after the last flush are NOT served to readers."""
+    a, b = os.urandom(40_000), os.urandom(40_000)
+    with cluster.client("w") as w, cluster.client("r") as r:
+        out = w.open_for_write("/partial")
+        out.write(a)
+        out.hflush()
+        out.write(b)               # buffered, never flushed
+        assert r.read("/partial") == a
+        out.close()
+        assert r.read("/partial") == a + b
+
+
+def test_stream_plain_write_roundtrip(cluster):
+    """The stream with no flush at all behaves like write()."""
+    data = os.urandom(600_000)     # > 2 blocks of 256 KiB
+    with cluster.client("w") as w, cluster.client("r") as r:
+        with w.open_for_write("/plain") as out:
+            for i in range(0, len(data), 100_000):
+                out.write(data[i:i + 100_000])
+        assert r.read("/plain") == data
+        st = r.stat("/plain")
+        assert st["length"] == len(data) and st["complete"]
+
+
+def test_hsync_metrics_and_empty_flush(cluster):
+    with cluster.client("w") as w:
+        out = w.open_for_write("/empty")
+        out.hflush()               # nothing buffered: a no-op, not an error
+        out.write(b"x")
+        out.hsync()
+        out.close()
+    with cluster.client("r") as r:
+        assert r.read("/empty") == b"x"
