@@ -281,6 +281,15 @@ class HdrfClient:
     def list_snapshots(self, path: str) -> list[str]:
         return self._call("list_snapshots", path=path)
 
+    def snapshot_diff(self, path: str, from_snap: str,
+                      to_snap: str = "") -> dict:
+        """Diff report between two snapshots (getSnapshotDiffReport,
+        SnapshotDiffInfo.java:44); empty ``to_snap`` diffs against the
+        current tree.  Entries: {type: CREATE|DELETE|MODIFY|RENAME, path,
+        [target]} with paths relative to the snapshot root."""
+        return self._call("snapshot_diff", path=path, from_snap=from_snap,
+                          to_snap=to_snap)
+
     def set_quota(self, path: str, namespace_quota: int = -1,
                   space_quota: int = -1) -> bool:
         return self._call("set_quota", path=path,
@@ -545,6 +554,109 @@ class HdrfClient:
                 _M.incr("read_failovers")
         raise IOError(f"all {len(locations)} locations failed for block "
                       f"{binfo['block_id']}: {last_err}")
+
+    # ------------------------------------------------------- file checksum
+
+    def get_file_checksum(self, path: str) -> dict:
+        """Whole-file checksum from per-block chunk CRCs
+        (FileChecksumHelper.java:56; BlockChecksumHelper.java:61 computes
+        the per-block half on the DN, :328 the striped block-group
+        variant).  COMPOSITE-CRC32C semantics (HDFS-13056): the combinable
+        CRC of the LOGICAL byte stream, so identical content yields the
+        identical checksum across replicated and EC-striped layouts — and
+        equals ``crc32c(file_bytes)`` outright.  No block data is read
+        except partial/misaligned EC tail cells.  Encryption-zone files
+        checksum their stored ciphertext (as the reference does)."""
+        from hdrf_tpu.utils.checksum import compose_chunks, crc32c_combine
+
+        loc = self._call("get_block_locations", path=path)
+        crc, pos = 0, 0
+        if loc.get("ec"):
+            from hdrf_tpu.ops import rs
+
+            k, _m, cell = rs.parse_policy(loc["ec"])
+            for grp in loc["groups"]:
+                glen = max(grp["length"], 0)
+                shard_info: dict[int, tuple] = {}
+
+                def info_of(i, _grp=grp, _cache=shard_info):
+                    if i not in _cache:
+                        _cache[i] = self._block_checksum(_grp["blocks"][i])
+                    return _cache[i]
+
+                gpos, c = 0, 0
+                while gpos < glen:
+                    take = min(cell, glen - gpos)
+                    row = c // k
+                    done = False
+                    if take == cell:   # tail cells never need the DN CRCs
+                        crcs, cchunk, _ln = info_of(c % k)
+                        if cell % cchunk == 0:
+                            i0 = row * cell // cchunk
+                            for cc in crcs[i0:i0 + cell // cchunk]:
+                                crc = cc if pos == 0 else \
+                                    crc32c_combine(crc, cc, cchunk)
+                                pos += cchunk
+                            done = True
+                    if not done:
+                        # partial tail cell (or cell not a chunk multiple):
+                        # the stored chunk CRC covers the zero PAD too, so
+                        # read the logical bytes and hash directly
+                        piece = self.read(path, offset=pos, length=take)
+                        pc = native.crc32c(piece)
+                        crc = pc if pos == 0 else \
+                            crc32c_combine(crc, pc, len(piece))
+                        pos += take
+                    gpos += take
+                    c += 1
+        else:
+            for binfo in loc["blocks"]:
+                blen = max(binfo["length"], 0)
+                if blen == 0:
+                    continue
+                crcs, cchunk, ln = self._block_checksum(binfo)
+                if ln == blen:
+                    bcrc, _ = compose_chunks(crcs, cchunk, blen)
+                else:
+                    # replica length disagrees with the located length (the
+                    # block grew past an hflush, or pipeline recovery
+                    # resized it): the tail chunk CRC no longer covers the
+                    # right span, so hash the block's bytes directly
+                    bcrc = native.crc32c(
+                        self.read(path, offset=pos, length=blen))
+                crc = bcrc if pos == 0 else crc32c_combine(crc, bcrc, blen)
+                pos += blen
+        _M.incr("file_checksums")
+        return {"algorithm": "COMPOSITE-CRC32C", "length": pos,
+                "crc": crc, "bytes": f"{crc:08x}"}
+
+    def _block_checksum(self, binfo: dict) -> tuple[list[int], int, int]:
+        """(chunk_crcs, chunk_size, logical_len) via the BLOCK_CHECKSUM op,
+        failing over across replica locations."""
+        last_err: Exception | None = None
+        for loc in binfo["locations"]:
+            sock = None
+            try:
+                sock = socket.create_connection(tuple(loc["addr"]),
+                                                timeout=60)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock = dt.secure_socket(sock, binfo.get("token"),
+                                        self.config.encrypt_data_transfer)
+                dt.send_op(sock, dt.BLOCK_CHECKSUM,
+                           block_id=binfo["block_id"],
+                           token=binfo.get("token"))
+                hdr = recv_frame(sock)
+                if hdr["status"] != 0:
+                    raise IOError(f"{hdr['error']}: {hdr['message']}")
+                return (list(hdr["checksums"]), hdr["checksum_chunk"],
+                        hdr["logical_len"])
+            except (OSError, ConnectionError, IOError) as e:
+                last_err = e
+            finally:
+                if sock is not None:
+                    sock.close()
+        raise IOError(f"block checksum failed for {binfo['block_id']}: "
+                      f"{last_err}")
 
     def _read_from(self, addr: tuple[str, int], block_id: int, offset: int,
                    length: int, token: dict | None = None) -> bytes:

@@ -417,6 +417,8 @@ class DataNode:
                 self.tokens.verify(fields.get("token"), fields["block_id"], "r")
                 self._sender.serve_read(sock, fields)
             elif op == dt.BLOCK_CHECKSUM:
+                self.tokens.verify(fields.get("token"), fields["block_id"],
+                                   "r")
                 self._serve_checksum(sock, fields)
             elif op == "replica_info":
                 self.tokens.verify(fields.get("token"), fields["block_id"], "r")

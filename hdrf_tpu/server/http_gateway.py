@@ -211,6 +211,35 @@ class HttpGateway:
                     self._json(200, {})
                 elif method == "DELETE" and op == "DELETE":
                     self._json(200, {"boolean": c.delete(path)})
+                elif method == "GET" and op == "GETFILECHECKSUM":
+                    fc = c.get_file_checksum(path)
+                    self._json(200, {"FileChecksum": {
+                        "algorithm": fc["algorithm"],
+                        "bytes": fc["bytes"],
+                        "length": fc["length"]}})
+                elif method == "PUT" and op == "ALLOWSNAPSHOT":
+                    c.allow_snapshot(path)
+                    self._json(200, {})
+                elif method == "GET" and op == "GETSNAPSHOTDIFF":
+                    # oldsnapshotname is REQUIRED (an omitted/typo'd param
+                    # must not silently diff the current tree against
+                    # itself and report "nothing changed")
+                    rep = c.snapshot_diff(
+                        path, q["oldsnapshotname"],
+                        q.get("snapshotname", ""))
+                    self._json(200, {"SnapshotDiffReport": {
+                        "snapshotRoot": rep["path"],
+                        "fromSnapshot": rep["from"],
+                        "toSnapshot": rep["to"],
+                        "diffList": rep["entries"]}})
+                elif method == "PUT" and op == "CREATESNAPSHOT":
+                    c.create_snapshot(path, q["snapshotname"])
+                    self._json(200, {"Path":
+                                     f"{path}/.snapshot/"
+                                     f"{q['snapshotname']}"})
+                elif method == "DELETE" and op == "DELETESNAPSHOT":
+                    c.delete_snapshot(path, q["snapshotname"])
+                    self._json(200, {})
                 elif method == "GET" and op == "GETDELEGATIONTOKEN":
                     tok = c._nn.call("get_delegation_token",
                                      renewer=q.get("renewer", c.user),

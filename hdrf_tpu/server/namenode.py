@@ -50,6 +50,10 @@ class FileNode:
     ec: str | None = None  # EC policy name ("rs-6-3-64k") or None
     attrs: Attrs = field(default_factory=lambda: Attrs(
         "hdrf", "supergroup", 0o644))
+    # stable inode id (INodeId analog): assigned at creation from a
+    # journaled counter, persisted through fsimage and snapshot freezes —
+    # what lets snapshot diff distinguish a rename from delete+create
+    inode_id: int = 0
 
 
 @dataclass
@@ -87,10 +91,75 @@ class SymNode:
     target: str
     attrs: Attrs = field(default_factory=lambda: Attrs(
         "hdrf", "supergroup", 0o777))
+    inode_id: int = 0
 
 
 class SymlinkRedirect(Exception):
     """Raised mid-resolution; the message IS the resolved path."""
+
+
+def _frozen_inode_id(node: list) -> int:
+    """Inode id embedded in a frozen-tree node (0 = pre-inode-id legacy)."""
+    if node[0] == "f":
+        return node[8] if len(node) > 8 else 0
+    return node[3] if len(node) > 3 else 0   # "d" and "l" share the slot
+
+
+def _index_frozen(tree: list) -> dict:
+    """Flatten a frozen tree into {identity_key: record}.  The key is the
+    inode id when present; legacy id-0 nodes fall back to path identity
+    (diff then degrades to delete+create for their renames — exactly the
+    pre-inode-id information content)."""
+    idx: dict = {}
+
+    def walk(node: list, path: str, parent_key, name: str):
+        nid = _frozen_inode_id(node)
+        key = nid if nid else f"p:{path or '/'}"
+        rec = {"path": path or "/", "parent": parent_key, "name": name,
+               "kind": node[0]}
+        if node[0] == "d":
+            # content signature = attrs only; membership is tracked via
+            # the children map (a changed map marks the DIR as modified,
+            # HDFS's "containing directory is reported modified" rule)
+            rec["sig"] = repr(node[2] if len(node) > 2 else None)
+            kids = {}
+            for cname, child in node[1].items():
+                ck = walk(child, f"{path}/{cname}", key, cname)
+                kids[cname] = ck
+            rec["children"] = kids
+        else:
+            rec["sig"] = repr(node[:8] if node[0] == "f" else node[:3])
+        idx[key] = rec
+        return key
+
+    walk(tree, "", None, "")
+    return idx
+
+
+def _diff_trees(a: list, b: list) -> list[dict]:
+    """SnapshotDiffInfo's delta computation over two frozen trees: a node
+    present in both counts as RENAMEd iff its (parent, name) changed, and
+    MODIFYd iff its content signature (or, for dirs, child membership)
+    changed; unmatched nodes are CREATE/DELETE."""
+    ia, ib = _index_frozen(a), _index_frozen(b)
+    entries: list[dict] = []
+    for k, rb in ib.items():
+        ra = ia.get(k)
+        if ra is None:
+            entries.append({"type": "CREATE", "path": rb["path"]})
+            continue
+        if (ra["parent"], ra["name"]) != (rb["parent"], rb["name"]):
+            entries.append({"type": "RENAME", "path": ra["path"],
+                            "target": rb["path"]})
+        changed = ra["sig"] != rb["sig"] or (
+            rb["kind"] == "d" and ra.get("children") != rb.get("children"))
+        if changed:
+            entries.append({"type": "MODIFY", "path": rb["path"]})
+    for k, ra in ia.items():
+        if k not in ib:
+            entries.append({"type": "DELETE", "path": ra["path"]})
+    entries.sort(key=lambda e: (e["path"], e["type"]))
+    return entries
 
 
 @dataclass
@@ -203,6 +272,9 @@ class NameNode:
         # namespace: nested DirNode tree; leaves are FileNode
         self._root: DirNode = DirNode(
             attrs=Attrs(self._superuser, "supergroup", 0o755))
+        # inode ids: deterministic across replay (assignment order follows
+        # the edit log), persisted in the fsimage; root is always 0
+        self._next_inode = 1
         self._blocks: dict[int, BlockInfo] = {}
         self._groups: dict[int, GroupInfo] = {}  # EC group_id -> group
         self._datanodes: dict[str, DatanodeInfo] = {}
@@ -350,6 +422,11 @@ class NameNode:
         except Exception:  # noqa: BLE001 — startup must make progress
             _M.incr("replay_records_skipped")
 
+    def _alloc_inode(self) -> int:
+        i = self._next_inode
+        self._next_inode += 1
+        return i
+
     def _snapshot(self) -> dict:
         def walk(node: dict) -> dict:
             out = {}
@@ -357,17 +434,21 @@ class NameNode:
                 if isinstance(child, FileNode):
                     out[name] = ["f", child.replication, child.scheme,
                                  child.blocks, child.complete, child.mtime,
-                                 child.ec, child.attrs.pack()]
+                                 child.ec, child.attrs.pack(),
+                                 child.inode_id]
                 elif isinstance(child, SymNode):
-                    out[name] = ["l", child.target, child.attrs.pack()]
+                    out[name] = ["l", child.target, child.attrs.pack(),
+                                 child.inode_id]
                 else:
                     out[name] = ["d", walk(child),
                                  child.attrs.pack()
-                                 if isinstance(child, DirNode) else None]
+                                 if isinstance(child, DirNode) else None,
+                                 getattr(child, "inode_id", 0)]
             return out
 
         return {
             "tree": walk(self._root),
+            "next_inode": self._next_inode,
             "root_attrs": self._root.attrs.pack(),
             "blocks": {b.block_id: [b.gen_stamp, b.length, b.path]
                        for b in self._blocks.values()},
@@ -396,16 +477,20 @@ class NameNode:
                         v[1], v[2], list(v[3]), v[4], v[5],
                         v[6] if len(v) > 6 else None,
                         Attrs.unpack(v[7] if len(v) > 7 else None,
-                                     mode=0o644))
+                                     mode=0o644),
+                        inode_id=v[8] if len(v) > 8 else 0)
                 elif v[0] == "l":
-                    out[name] = SymNode(v[1], Attrs.unpack(v[2]))
+                    out[name] = SymNode(v[1], Attrs.unpack(v[2]),
+                                        inode_id=v[3] if len(v) > 3 else 0)
                 else:
                     d = walk(v[1])
                     d.attrs = Attrs.unpack(v[2] if len(v) > 2 else None)
+                    d.inode_id = v[3] if len(v) > 3 else 0
                     out[name] = d
             return out
 
         self._root = walk(snap["tree"])
+        self._next_inode = snap.get("next_inode", 1)
         self._root.attrs = Attrs.unpack(
             snap.get("root_attrs"), owner=self._superuser)
         self._blocks = {bid: BlockInfo(bid, gs, ln, path)
@@ -443,9 +528,10 @@ class NameNode:
             attrs = perm.inherit_attrs(
                 self._dir_attrs(parent), user or self._superuser, None,
                 is_dir=False, umode=mode)
-            parent[name] = FileNode(replication, scheme, mtime=mtime,
-                                    ec=rest[0] if rest else None,
-                                    attrs=attrs)
+            node = FileNode(replication, scheme, mtime=mtime,
+                            ec=rest[0] if rest else None, attrs=attrs)
+            node.inode_id = self._alloc_inode()
+            parent[name] = node
         elif op == "add_block_group":
             _, path, bids, gs = rec
             node = self._file(path)
@@ -581,7 +667,8 @@ class NameNode:
                                            user=rest[0] if rest else None)
             parent[name] = SymNode(target, perm.inherit_attrs(
                 self._dir_attrs(parent), rest[0] if rest
-                else self._superuser, None, is_dir=False, umode=0o777))
+                else self._superuser, None, is_dir=False, umode=0o777),
+                inode_id=self._alloc_inode())
         elif op == "ezkey":
             self._ezkeys[rec[1]] = bytes(rec[2])
         elif op == "ez":
@@ -953,7 +1040,7 @@ class NameNode:
                     raise FileNotFoundError(f"parent of {path} does not exist")
                 child = node[p] = DirNode(attrs=perm.inherit_attrs(
                     self._dir_attrs(node), user or self._superuser, None,
-                    is_dir=True))
+                    is_dir=True), inode_id=self._alloc_inode())
             if isinstance(child, SymNode):
                 self._link_redirect(child.target, parts[:i + 1],
                                     parts[i + 1:])
@@ -1090,7 +1177,8 @@ class NameNode:
                 child = node[p] = DirNode(attrs=perm.inherit_attrs(
                     self._dir_attrs(node), user or self._superuser, None,
                     is_dir=True,
-                    umode=mode if i == len(parts) - 1 else None))
+                    umode=mode if i == len(parts) - 1 else None),
+                    inode_id=self._alloc_inode())
             if isinstance(child, FileNode):
                 raise FileExistsError(f"{path}: {p} is a file")
             node = child
@@ -1139,12 +1227,14 @@ class NameNode:
         blocks are immutable."""
         if isinstance(node, FileNode):
             return ["f", node.replication, node.scheme, list(node.blocks),
-                    node.complete, node.mtime, node.ec, node.attrs.pack()]
+                    node.complete, node.mtime, node.ec, node.attrs.pack(),
+                    node.inode_id]
         if isinstance(node, SymNode):
-            return ["l", node.target, node.attrs.pack()]
+            return ["l", node.target, node.attrs.pack(), node.inode_id]
         return ["d", {name: NameNode._freeze(child)
                       for name, child in node.items()},
-                node.attrs.pack() if isinstance(node, DirNode) else None]
+                node.attrs.pack() if isinstance(node, DirNode) else None,
+                getattr(node, "inode_id", 0)]
 
     def _thaw(self, v: Any) -> Any:
         """Frozen form -> read-only live-form objects (for resolution through
@@ -1153,11 +1243,14 @@ class NameNode:
             return FileNode(v[1], v[2], list(v[3]), v[4], v[5],
                             v[6] if len(v) > 6 else None,
                             Attrs.unpack(v[7] if len(v) > 7 else None,
-                                         mode=0o644))
+                                         mode=0o644),
+                            inode_id=v[8] if len(v) > 8 else 0)
         if v[0] == "l":
-            return SymNode(v[1], Attrs.unpack(v[2]))
+            return SymNode(v[1], Attrs.unpack(v[2]),
+                           inode_id=v[3] if len(v) > 3 else 0)
         d = DirNode({name: self._thaw(child) for name, child in v[1].items()})
         d.attrs = Attrs.unpack(v[2] if len(v) > 2 else None)
+        d.inode_id = v[3] if len(v) > 3 else 0
         return d
 
     def _tree_blocks(self, v: Any) -> tuple[set[int], set[int]]:
@@ -2142,6 +2235,34 @@ class NameNode:
             if p not in self._snapshots:
                 raise FileNotFoundError(f"{p} is not snapshottable")
             return sorted(self._snapshots[p])
+
+    def rpc_snapshot_diff(self, path: str, from_snap: str,
+                          to_snap: str = "") -> dict:
+        """Created/deleted/modified/renamed deltas between two snapshots of
+        a snapshottable root (SnapshotManager.getSnapshotDiffReport,
+        SnapshotDiffInfo.java:44) — renames are matched by inode id, so a
+        moved file reports RENAME instead of delete+create (what makes
+        snapshots usable for incremental distcp).  Empty ``to_snap`` diffs
+        against the CURRENT tree ('.' in the reference CLI).  Paths in the
+        report are relative to the snapshot root."""
+        with self._lock:
+            self._check_access(path, want=perm.READ)
+            p = "/" + "/".join(self._parts(path))
+            snaps = self._snapshots.get(p)
+            if snaps is None:
+                raise FileNotFoundError(f"{p} is not snapshottable")
+
+            def tree_of(name: str):
+                if not name:
+                    return self._freeze(self._resolve(p))
+                if name not in snaps:
+                    raise FileNotFoundError(f"no snapshot {name} of {p}")
+                return snaps[name]
+
+            entries = _diff_trees(tree_of(from_snap), tree_of(to_snap))
+            _M.incr("snapshot_diffs")
+            return {"path": p, "from": from_snap, "to": to_snap,
+                    "entries": entries}
 
     def rpc_set_quota(self, path: str, namespace_quota: int = -1,
                       space_quota: int = -1) -> bool:

@@ -65,9 +65,11 @@ class DirNode(dict):
     """Directory inode: a dict of children + security attributes.  Keeps
     ``isinstance(node, dict)`` true everywhere the namespace walks."""
 
-    def __init__(self, *a, attrs: Attrs | None = None, **kw):
+    def __init__(self, *a, attrs: Attrs | None = None,
+                 inode_id: int = 0, **kw):
         super().__init__(*a, **kw)
         self.attrs = attrs or Attrs("hdrf", "supergroup", 0o755)
+        self.inode_id = inode_id  # stable identity for snapshot diff
 
 
 _CTX = threading.local()

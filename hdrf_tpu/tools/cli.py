@@ -9,6 +9,7 @@ dispatch; DFSAdmin, OfflineImageViewer / OfflineEditsViewer under
   httpfs                   WebHDFS-style HTTP gateway
   dfs                      -ls -mkdir -put -get -cat -rm -mv -stat -du -count
                            -createSnapshot -deleteSnapshot -lsSnapshots
+                           -snapshotDiff -checksum
                            -chmod -chown -getfacl -setfacl -setfattr -getfattr
   mover                    migrate replicas to satisfy storage policies
   dfsadmin                 -report -savenamespace -metrics -slowPeers
@@ -148,6 +149,20 @@ def cmd_dfs(args) -> int:
         elif args.op == "-lsSnapshots":
             for name in c.list_snapshots(args.args[0]):
                 print(name)
+        elif args.op == "-checksum":
+            fc = c.get_file_checksum(args.args[0])
+            print(f"{args.args[0]}\t{fc['algorithm']}\t{fc['bytes']}")
+        elif args.op == "-snapshotDiff":
+            # <root> <from> <to>; "." for <to> = the current tree
+            root, frm, to = args.args[0], args.args[1], args.args[2]
+            rep = c.snapshot_diff(root, frm, "" if to == "." else to)
+            marks = {"CREATE": "+", "DELETE": "-", "MODIFY": "M",
+                     "RENAME": "R"}
+            for e in rep["entries"]:
+                line = f"{marks[e['type']]}\t{e['path']}"
+                if e["type"] == "RENAME":
+                    line += f" -> {e['target']}"
+                print(line)
         elif args.op == "-chmod":
             c.chmod(args.args[1], int(args.args[0], 8))
         elif args.op == "-chown":

@@ -163,3 +163,33 @@ class TestWebHdfsFileSystem:
         assert http.read("/s/link") == b"sym"
         assert http.op_json("GET", "/", "GETHOMEDIRECTORY")[
             "Path"].startswith("/user/")
+
+    def test_snapshot_ops_and_diff(self, fs):
+        """ALLOWSNAPSHOT / CREATESNAPSHOT / GETSNAPSHOTDIFF /
+        DELETESNAPSHOT over pure HTTP (the reference's snapshot webhdfs
+        op set)."""
+        http, _ = fs
+        assert http.op_json("PUT", "/snap", "MKDIRS")["boolean"]
+        http.write("/snap/a", b"one")
+        http.op_json("PUT", "/snap", "ALLOWSNAPSHOT")
+        out = http.op_json("PUT", "/snap", "CREATESNAPSHOT",
+                           snapshotname="s1")
+        assert out["Path"] == "/snap/.snapshot/s1"
+        http.write("/snap/b", b"two")
+        rep = http.op_json("GET", "/snap", "GETSNAPSHOTDIFF",
+                           oldsnapshotname="s1", snapshotname="")[
+            "SnapshotDiffReport"]
+        assert {"type": "CREATE", "path": "/b"} in rep["diffList"]
+        # reading through the frozen tree still works
+        assert http.read("/snap/.snapshot/s1/a") == b"one"
+        http.op_json("DELETE", "/snap", "DELETESNAPSHOT",
+                     snapshotname="s1")
+
+    def test_getfilechecksum(self, fs):
+        http, _ = fs
+        http.write("/fck", b"checksum-me" * 1000)
+        out = http.op_json("GET", "/fck", "GETFILECHECKSUM")["FileChecksum"]
+        from hdrf_tpu import native
+        assert out["algorithm"] == "COMPOSITE-CRC32C"
+        assert out["bytes"] == f"{native.crc32c(b'checksum-me' * 1000):08x}"
+        assert out["length"] == 11_000
