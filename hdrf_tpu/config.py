@@ -181,6 +181,19 @@ class DataNodeConfig:
     # (dfs.encrypt.data.transfer): plaintext ops are refused, and this DN's
     # own outgoing legs (mirroring, transfers, reconstruction) encrypt.
     encrypt_data_transfer: bool = False
+    # Cap on BACKGROUND transfer legs — balancer moves, NN-commanded
+    # re-replication, EC reconstruction fan-in — in bytes/s
+    # (dfs.datanode.balance.bandwidthPerSec analog; the reference defaults
+    # to 100 MB/s).  0 disables.  Live-reconfigurable, and settable
+    # cluster-wide via ``dfsadmin -setBalancerBandwidth``.
+    balancer_bandwidth: int = 100 * 1024 * 1024
+    # Lazy-persist (RAM_DISK) machinery: the lazy writer copies RAM
+    # replicas to DISK every this many seconds (0 disables; the loop only
+    # starts when a RAM_DISK volume is configured), and evicts persisted
+    # RAM copies once the RAM volume exceeds the capacity budget
+    # (dfs.datanode.ram.disk.low.watermark analog, expressed as a cap).
+    lazy_writer_interval_s: float = 3.0
+    ram_disk_capacity: int = 64 * 1024 * 1024
     reduction: ReductionConfig = field(default_factory=ReductionConfig)
 
 

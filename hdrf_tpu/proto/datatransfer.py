@@ -134,13 +134,19 @@ def read_ack(sock: socket.socket) -> tuple[int, int]:
 
 
 def stream_bytes(sock: socket.socket, data: bytes,
-                 packet_size: int = DEFAULT_PACKET, base_seqno: int = 0) -> int:
+                 packet_size: int = DEFAULT_PACKET, base_seqno: int = 0,
+                 throttle=None) -> int:
     """Packetize ``data`` onto the socket, ending with an empty LAST packet
     (the reference's zero-payload trailer that carries lastPacketInBlock).
-    Returns the number of packets sent."""
+    Returns the number of packets sent.  ``throttle(nbytes)`` is invoked
+    before each packet when given (DataTransferThrottler's per-packet
+    gating in BlockSender.sendPacket)."""
     seqno = base_seqno
     for off in range(0, len(data), packet_size):
-        write_packet(sock, seqno, data[off:off + packet_size])
+        pkt = data[off:off + packet_size]
+        if throttle is not None:
+            throttle(len(pkt))
+        write_packet(sock, seqno, pkt)
         seqno += 1
     write_packet(sock, seqno, b"", last=True)
     return seqno - base_seqno + 1

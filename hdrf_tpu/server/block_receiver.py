@@ -317,11 +317,13 @@ class BlockReceiver:
 
     def push_reduced(self, block_id: int, gen_stamp: int, scheme_name: str,
                      logical_len: int, stored: bytes, crcs: list[int],
-                     targets: list) -> None:
+                     targets: list, throttler=None) -> None:
         """Ship the reduced form to targets[0], which relays to the rest.
         Used by both pipeline mirroring and NN-commanded re-replication
         (transferBlock, DataNode.java:2361 — which the reference serves by
-        reconstructing FULL bytes, §3.3 note)."""
+        reconstructing FULL bytes, §3.3 note).  ``throttler`` caps the
+        send rate on background legs (balancer moves, re-replication —
+        DataTransferThrottler's role); client pipeline legs pass None."""
         dn = self._dn
         scheme = dn.scheme(scheme_name)
         push_t0 = time.perf_counter()
@@ -348,6 +350,8 @@ class BlockReceiver:
                 seqno = 0
                 sent_bytes = 0
                 for chunk in chunks:
+                    if throttler is not None:
+                        throttler.throttle(len(chunk))
                     dt.write_packet(mirror, seqno, chunk)
                     sent_bytes += len(chunk)
                     seqno += 1
@@ -362,7 +366,9 @@ class BlockReceiver:
                            token=dn.tokens.mint(block_id, "w"),
                            hashes=None, targets=targets[1:])
                 recv_frame(mirror)  # symmetric need-frame (always empty here)
-                dt.stream_bytes(mirror, stored, dn.config.packet_size)
+                dt.stream_bytes(mirror, stored, dn.config.packet_size,
+                                throttle=throttler.throttle
+                                if throttler is not None else None)
                 sent_bytes = len(stored)
                 _, status = dt.read_ack(mirror)
             if status != dt.ACK_SUCCESS:

@@ -112,6 +112,11 @@ class DataNode:
         failover) but executes commands only from the active."""
         self.config = config
         self.checksum_chunk = 64 * 1024
+        # background-transfer cap (DataTransferThrottler analog): balancer
+        # moves, re-replication, EC reconstruction — never client pipelines
+        from hdrf_tpu.utils.throttler import Throttler
+
+        self.balance_throttler = Throttler(config.balancer_bandwidth)
         red = config.reduction
         # Layout check/upgrade BEFORE anything opens the store (the
         # reference's Storage.analyzeStorage + doUpgrade at startup): a
@@ -280,7 +285,25 @@ class DataNode:
                                   name=f"{self.dn_id}-volcheck", daemon=True)
             vc.start()
             self._threads.append(vc)
+        if self.config.lazy_writer_interval_s > 0 \
+                and not self.config.simulated_dataset \
+                and any(v.storage_type == "RAM_DISK"
+                        for v in self.volumes.volumes):
+            lw = threading.Thread(target=self._lazy_writer_loop,
+                                  name=f"{self.dn_id}-lazywriter",
+                                  daemon=True)
+            lw.start()
+            self._threads.append(lw)
         return self
+
+    def _lazy_writer_loop(self) -> None:
+        """RamDiskAsyncLazyPersistService analog: shadow RAM replicas onto
+        DISK, evict persisted ones past the RAM capacity budget."""
+        while not self._stop.wait(self.config.lazy_writer_interval_s):
+            try:
+                self.volumes.lazy_persist_tick(self.config.ram_disk_capacity)
+            except Exception:  # noqa: BLE001 — a bad volume must not kill
+                _M.incr("lazy_writer_errors")
 
     def stop(self) -> None:
         self._stop.set()
@@ -615,6 +638,12 @@ class DataNode:
         elif cmd["cmd"] == "uncache":
             for bid in cmd["block_ids"]:
                 self.cache.unpin(bid)
+        elif cmd["cmd"] == "balancer_bandwidth":
+            # dfsadmin -setBalancerBandwidth rides the heartbeat (the
+            # reference's BalancerBandwidthCommand)
+            self.config.balancer_bandwidth = int(cmd["bytes_per_s"])
+            self.balance_throttler.set_rate(cmd["bytes_per_s"])
+            _M.incr("bandwidth_commands")
         elif cmd["cmd"] == "finalize_upgrade":
             from hdrf_tpu.storage import version as storage_version
 
@@ -713,6 +742,7 @@ class DataNode:
     RECONFIGURABLE = frozenset({
         "scan_interval_s", "volume_check_interval_s",
         "block_report_interval_s", "cache_capacity",
+        "balancer_bandwidth",
     })
 
     def reconfigure(self, key: str, value) -> dict:
@@ -746,6 +776,8 @@ class DataNode:
         setattr(self.config, key, cast)
         if key == "cache_capacity":
             self.cache.set_capacity(int(cast))
+        elif key == "balancer_bandwidth":
+            self.balance_throttler.set_rate(cast)
         _M.incr("reconfigurations")
         return {"ok": True, "key": key, "old": old, "new": cast}
 
@@ -807,7 +839,8 @@ class DataNode:
         stored = self.replicas.read_data(block_id) if meta.physical_len else b""
         self._receiver.push_reduced(block_id, meta.gen_stamp, meta.scheme,
                                     meta.logical_len, stored, meta.checksums,
-                                    cmd["targets"])
+                                    cmd["targets"],
+                                    throttler=self.balance_throttler)
         _M.incr("blocks_replicated")
 
     def _ec_reconstruct(self, cmd: dict) -> None:
@@ -830,6 +863,8 @@ class DataNode:
                         tuple(loc["addr"]), surv["block_id"],
                         token=self.tokens.mint(surv["block_id"], "r"),
                         encrypt=self.config.encrypt_data_transfer)
+                    # reconstruction fan-in is a background leg too
+                    self.balance_throttler.throttle(len(data))
                     shards[surv["index"]] = np.frombuffer(data, dtype=np.uint8)
                     break
                 except (OSError, ConnectionError, IOError):

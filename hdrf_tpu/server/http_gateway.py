@@ -55,8 +55,21 @@ def decode_token(s: str) -> dict:
 
 class HttpGateway:
     def __init__(self, namenode_addr: tuple[str, int], host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, oauth2_introspect_url: str | None = None,
+                 gate_token_issue: bool = False):
+        """``oauth2_introspect_url``: RFC 7662 endpoint; when set,
+        ``Authorization: Bearer`` tokens authenticate requests (the
+        server-side counterpart of the reference's web/oauth2 client
+        providers) and the introspected username becomes the acting
+        identity.  ``gate_token_issue``: refuse GETDELEGATIONTOKEN to
+        unauthenticated callers — without it the op mints a token for
+        whatever ``user.name`` claims, which is only acceptable on
+        simple-auth clusters (the reference gates issuance behind
+        Kerberos)."""
         self._nn_addr = namenode_addr
+        self._introspect_url = oauth2_introspect_url
+        self._gate_token_issue = gate_token_issue
+        self._bearer_cache: dict[str, tuple[str, float]] = {}
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -111,6 +124,20 @@ class HttpGateway:
                         return self._json(404, {"error": "not found"})
                     path = unquote(u.path[len(PREFIX):]) or "/"
                     op = q.get("op", "").upper()
+                    # _bearer is a GATEWAY-INTERNAL marker: strip any
+                    # attacker-supplied query param of that name before the
+                    # Bearer branch may set it (otherwise ?_bearer=1 would
+                    # spoof an authenticated caller past gate_token_issue)
+                    q.pop("_bearer", None)
+                    auth = self.headers.get("Authorization", "")
+                    if auth.startswith("Bearer "):
+                        user = gateway._bearer_user(auth[7:])
+                        if user is None:
+                            return self._json(401, {
+                                "error": "AccessControlException",
+                                "message": "invalid bearer token"})
+                        q["user.name"] = user
+                        q["_bearer"] = "1"
                     with gateway._client(q) as c:
                         return self._op(c, method, op, path, q)
                 except Exception as e:  # noqa: BLE001 — HTTP boundary
@@ -130,7 +157,8 @@ class HttpGateway:
                 # unread bytes would be parsed as the next request line on
                 # this keep-alive connection (HTTP/1.1 desync)
                 self._body()
-                keep = {k: v for k, v in q.items() if k != "noredirect"}
+                keep = {k: v for k, v in q.items()
+                        if k not in ("noredirect", "_bearer")}
                 keep["step"] = "2"
                 loc = (f"http://{self.headers.get('Host', 'localhost')}"
                        f"{PREFIX}{quote(path)}?"
@@ -241,6 +269,18 @@ class HttpGateway:
                     c.delete_snapshot(path, q["snapshotname"])
                     self._json(200, {})
                 elif method == "GET" and op == "GETDELEGATIONTOKEN":
+                    # With gate_token_issue, issuance requires an already
+                    # AUTHENTICATED identity (bearer or existing
+                    # delegation token) — otherwise any HTTP caller could
+                    # mint a token for any claimed user.name (the
+                    # reference gates this leg behind Kerberos; plain
+                    # simple-auth deployments leave the gate off)
+                    if gateway._gate_token_issue and \
+                            "_bearer" not in q and "delegation" not in q:
+                        return self._json(403, {
+                            "error": "AccessControlException",
+                            "message": "token issuance requires an "
+                                       "authenticated caller"})
                     tok = c._nn.call("get_delegation_token",
                                      renewer=q.get("renewer", c.user),
                                      owner=c.user)
@@ -277,6 +317,39 @@ class HttpGateway:
     @property
     def addr(self) -> tuple[str, int]:
         return self._server.server_address
+
+    def _bearer_user(self, token: str) -> str | None:
+        """RFC 7662 introspection with a short positive cache; None =
+        inactive/invalid.  No introspection endpoint configured = no
+        bearer auth (the header is rejected rather than trusted)."""
+        import time as _t
+        import urllib.parse
+        import urllib.request
+
+        if not self._introspect_url:
+            return None
+        hit = self._bearer_cache.get(token)
+        if hit and hit[1] > _t.monotonic():
+            return hit[0]
+        try:
+            req = urllib.request.Request(
+                self._introspect_url,
+                data=urllib.parse.urlencode({"token": token}).encode(),
+                method="POST",
+                headers={"Content-Type":
+                         "application/x-www-form-urlencoded"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                out = json.loads(r.read())
+        except OSError:
+            return None
+        if not out.get("active"):
+            return None
+        user = out.get("username") or out.get("sub") or "oauth2-user"
+        self._bearer_cache[token] = (user, _t.monotonic() + 30.0)
+        if len(self._bearer_cache) > 1024:
+            self._bearer_cache.clear()   # crude bound; entries re-fetch
+        _M.incr("bearer_auths")
+        return user
 
     def _client(self, q: dict) -> HdrfClient:
         """Per-request client with the caller's identity: a delegation

@@ -2588,6 +2588,18 @@ class NameNode:
 
     # ------------------------------------------------------------- admin RPC
 
+    def rpc_set_balancer_bandwidth(self, bytes_per_s: int) -> int:
+        """Broadcast a background-transfer bandwidth cap to every DataNode
+        via its next heartbeat (DFSAdmin setBalancerBandwidth ->
+        BalancerBandwidthCommand).  Returns the number of DNs queued."""
+        with self._lock:
+            self._check_access("/", super_only=True)
+            for d in self._datanodes.values():
+                d.commands.append({"cmd": "balancer_bandwidth",
+                                   "bytes_per_s": int(bytes_per_s)})
+            _M.incr("set_balancer_bandwidth")
+            return len(self._datanodes)
+
     def rpc_datanode_report(self) -> list[dict]:
         with self._lock:
             now = time.monotonic()

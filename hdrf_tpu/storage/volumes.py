@@ -44,9 +44,23 @@ class Volume:
     def __init__(self, vol_id: int, root: str, storage_type: str,
                  container_kw: dict):
         self.vol_id = vol_id
-        self.root = root
         self.storage_type = storage_type
         self.failed = False
+        if storage_type == "RAM_DISK" and os.access("/dev/shm", os.W_OK):
+            # shm-backed volume (RamDiskReplicaTracker.java:38's tmpfs
+            # requirement): bytes live in RAM, persist across DN restarts,
+            # vanish on machine reboot — which is why the lazy writer
+            # exists.  The dir is keyed to the CONFIGURED root so a
+            # restarted DN finds its RAM replicas; an ``origin`` marker
+            # lets test harnesses reclaim leaked segments.
+            import hashlib
+            tag = hashlib.sha1(os.path.abspath(root).encode()).hexdigest()[:16]
+            shm = os.path.join("/dev/shm", f"hdrf-ram-{tag}")
+            os.makedirs(shm, exist_ok=True)
+            with open(os.path.join(shm, "origin"), "w") as f:
+                f.write(os.path.abspath(root))
+            root = shm
+        self.root = root
         os.makedirs(root, exist_ok=True)
         self.replicas = ReplicaStore(os.path.join(root, "replicas"))
         self.containers = ContainerStore(
@@ -86,9 +100,16 @@ class VolumeSet:
             for i, t in enumerate(types)]
         self._where: dict[int, int] = {}     # block_id -> vol_id
         self._rr = 0
+        best_gs: dict[int, int] = {}
         for v in self.volumes:
-            for bid in v.replicas.block_ids():
-                self._where[bid] = v.vol_id
+            for bid, gs, _ln in v.replicas.block_report():
+                # the lazy writer leaves shadow copies on DISK: ownership
+                # after restart goes to the HIGHEST generation (scan-order
+                # would let a stale shadow win and the next lazy tick
+                # would then delete the newer RAM copy as "stale")
+                if bid not in best_gs or gs > best_gs[bid]:
+                    best_gs[bid] = gs
+                    self._where[bid] = v.vol_id
         self._containers = MultiContainerStore(self)
 
     # ------------------------------------------------------------ routing
@@ -118,12 +139,20 @@ class VolumeSet:
 
     # ----------------------------------------------------- replica surface
 
-    def _choose_volume(self, storage_type: str | None) -> Volume:
+    def _choose_volume(self, storage_type: str | None,
+                       exclude_ram: bool = False) -> Volume:
         """Type match first (the NameNode's slot hint), then the volume
         with the most free space among candidates; round-robin breaks
         ties (FsVolumeList's AvailableSpaceVolumeChoosingPolicy over the
         round-robin default)."""
         alive = self._alive()
+        if exclude_ram:
+            alive = [v for v in alive if v.storage_type != "RAM_DISK"]
+            if not alive:
+                # NEVER fall back to RAM for shared chunk containers: a
+                # reboot would corrupt every referencing block — refuse
+                # and let the write degrade to re-replication elsewhere
+                raise IOError("no non-RAM volume available for containers")
         if not alive:
             raise IOError("all volumes failed")
         cands = [v for v in alive if v.storage_type == storage_type] or alive
@@ -152,10 +181,18 @@ class VolumeSet:
 
     def read_data(self, block_id: int, offset: int = 0,
                   length: int = -1) -> bytes:
-        v = self._vol_of(block_id)
-        if v is None:
-            raise IOError(f"block {block_id}: no live volume holds it")
-        return v.replicas.read_data(block_id, offset, length)
+        for attempt in range(2):
+            v = self._vol_of(block_id)
+            if v is None:
+                raise IOError(f"block {block_id}: no live volume holds it")
+            try:
+                return v.replicas.read_data(block_id, offset, length)
+            except FileNotFoundError:
+                # lazy-persist eviction raced us: _where already points at
+                # the disk copy — re-resolve once
+                if attempt:
+                    raise
+        raise IOError(f"block {block_id}: unreadable")  # pragma: no cover
 
     def data_path(self, block_id: int) -> str:
         v = self._vol_of(block_id)
@@ -170,27 +207,35 @@ class VolumeSet:
                                            new_gs=new_gs) if v else False
 
     def delete(self, block_id: int) -> None:
-        v = self._vol_of(block_id)
-        if v is not None:
-            v.replicas.delete(block_id)
+        # sweep EVERY volume, not just the owner: the lazy writer keeps
+        # shadow disk copies of RAM replicas, and an owner-only delete
+        # would orphan them
+        for v in self._alive():
+            if v.replicas.get_meta(block_id) is not None \
+                    or v.replicas.is_rbw(block_id):
+                v.replicas.delete(block_id)
         with self._lock:
             self._where.pop(block_id, None)
 
     def block_ids(self) -> list[int]:
         out: list[int] = []
         for v in self._alive():
-            out.extend(v.replicas.block_ids())
+            out.extend(bid for bid in v.replicas.block_ids()
+                       if self._where.get(bid) == v.vol_id)
         return out
 
     def block_report(self) -> list[tuple[int, int, int, str]]:
         """(block_id, gen_stamp, logical_len, storage_type) per replica —
         the reference reports per-storage (DatanodeStorageInfo), which is
         what lets the NameNode see each replica's actual type on
-        multi-type nodes."""
+        multi-type nodes.  Only the OWNING volume's copy is reported: the
+        lazy writer keeps shadow disk copies of RAM replicas, and a
+        double row for one block would confuse the NN's replica count."""
         out = []
         for v in self._alive():
             out.extend((bid, gs, ln, v.storage_type)
-                       for bid, gs, ln in v.replicas.block_report())
+                       for bid, gs, ln in v.replicas.block_report()
+                       if self._where.get(bid) == v.vol_id)
         return out
 
     def scan(self) -> list[str]:
@@ -221,15 +266,82 @@ class VolumeSet:
             return []
         v.failed = True
         with self._lock:
-            lost = [bid for bid, vid in self._where.items() if vid == vol_id]
-            for bid in lost:
-                self._where.pop(bid, None)
+            affected = [bid for bid, vid in self._where.items()
+                        if vid == vol_id]
+            lost = []
+            for bid in affected:
+                # a lazy-persisted shadow on a surviving volume rescues
+                # the block (RAM volume death is the exact scenario the
+                # lazy writer exists for) — fail ownership over instead
+                # of declaring it lost
+                for sv in self.volumes:
+                    if not sv.failed and sv.vol_id != vol_id \
+                            and sv.replicas.get_meta(bid) is not None:
+                        self._where[bid] = sv.vol_id
+                        _M.incr("blocks_rescued_by_shadow")
+                        break
+                else:
+                    self._where.pop(bid, None)
+                    lost.append(bid)
         _M.incr("volumes_ejected")
         _M.incr("blocks_lost_to_volume_failure", len(lost))
         return lost
 
     def alive_count(self) -> int:
         return len(self._alive())
+
+    # ------------------------------------------------------- lazy persist
+
+    def lazy_persist_tick(self, ram_capacity: int) -> tuple[int, int]:
+        """One lazy-writer pass (RamDiskReplicaTracker.java:38 +
+        LazyWriter semantics): every finalized replica on a RAM_DISK
+        volume gets a shadow copy on a DISK volume (the durability half);
+        then, while the RAM volume exceeds ``ram_capacity``, persisted
+        replicas are EVICTED — ownership flips to the disk copy and the
+        RAM bytes are reclaimed.  Reads keep hitting RAM until eviction
+        (the fast-read half).  Returns (persisted, evicted)."""
+        rams = [v for v in self._alive() if v.storage_type == "RAM_DISK"]
+        disks = [v for v in self._alive() if v.storage_type != "RAM_DISK"]
+        if not rams or not disks:
+            return (0, 0)
+        persisted = evicted = 0
+        for rv in rams:
+            for bid, gs, _ln in rv.replicas.block_report():
+                if self._where.get(bid) != rv.vol_id:
+                    # stale RAM copy (evicted or superseded): reclaim
+                    rv.replicas.delete(bid)
+                    continue
+                if rv.replicas.is_rbw(bid):
+                    continue
+                meta = rv.replicas.get_meta(bid)
+                if meta is None:
+                    continue
+                dv = max(disks, key=lambda v: v.free_estimate())
+                dm = dv.replicas.get_meta(bid)
+                if dm is None or dm.gen_stamp < meta.gen_stamp:
+                    dv.replicas.adopt(meta, rv.replicas.read_data(bid))
+                    persisted += 1
+                    _M.incr("lazy_persisted")
+            while rv.used_bytes() > ram_capacity:
+                flipped = False
+                for bid, gs, _ln in rv.replicas.block_report():
+                    if self._where.get(bid) != rv.vol_id:
+                        continue
+                    for dv in disks:
+                        dm = dv.replicas.get_meta(bid)
+                        if dm is not None and dm.gen_stamp >= gs:
+                            with self._lock:
+                                self._where[bid] = dv.vol_id
+                            rv.replicas.delete(bid)
+                            evicted += 1
+                            flipped = True
+                            _M.incr("lazy_evicted")
+                            break
+                    if flipped:
+                        break
+                if not flipped:
+                    break   # nothing evictable yet (unpersisted writes)
+        return persisted, evicted
 
     # ----------------------------------------------------- disk balancer
 
@@ -305,7 +417,9 @@ class MultiContainerStore:
         self._vs = vs
 
     def append_chunks(self, chunks, on_seal=None, sync: bool = True):
-        vol = self._vs._choose_volume(None)
+        # chunk containers hold SHARED dedup bytes: never place them on a
+        # RAM_DISK volume (a reboot would corrupt every referencing block)
+        vol = self._vs._choose_volume(None, exclude_ram=True)
         return vol.containers.append_chunks(chunks, on_seal=on_seal,
                                             sync=sync)
 

@@ -29,7 +29,8 @@ class MiniCluster:
                  storage_types: list[str] | None = None,
                  volume_types: list[str] | None = None,
                  nameservices: int = 1,
-                 tpu_worker: bool = False):
+                 tpu_worker: bool = False,
+                 dn_config_overrides: dict | None = None):
         """``journal_nodes`` > 0 boots that many JournalNodes and puts the
         edit log on the quorum (MiniQJMHACluster analog); each NN then gets
         its OWN meta_dir (only the shared-dir deployment shares one).
@@ -47,6 +48,7 @@ class MiniCluster:
         self.storage_types = storage_types or []
         # per-DN volume types (multi-volume DNs); applies to EVERY DN
         self.volume_types = volume_types
+        self.dn_config_overrides = dn_config_overrides or {}
         self.tpu_worker = tpu_worker
         self._worker_proc = None
         self._worker_addr = None
@@ -164,6 +166,8 @@ class MiniCluster:
             cfg.storage_type = self.storage_types[i]
         if self.volume_types is not None:
             cfg.volume_types = list(self.volume_types)
+        for k, v in self.dn_config_overrides.items():
+            setattr(cfg, k, v)
         addr = (self.all_ns_addrs() if self.nameservices_n > 1
                 else self.nn_addrs())
         return DataNode(cfg, addr, dn_id=f"dn-{i}")
@@ -194,6 +198,18 @@ class MiniCluster:
             self._worker_proc = None
         if self._own_dir:
             shutil.rmtree(self.base_dir, ignore_errors=True)
+        # reclaim shm segments of RAM_DISK volumes rooted under base_dir
+        # (they deliberately survive DN restarts, so sweep by origin)
+        import glob
+        for marker in glob.glob("/dev/shm/hdrf-ram-*/origin"):
+            try:
+                with open(marker) as f:
+                    if f.read().startswith(
+                            os.path.abspath(self.base_dir) + os.sep):
+                        shutil.rmtree(os.path.dirname(marker),
+                                      ignore_errors=True)
+            except OSError:
+                pass
 
     def __enter__(self) -> "MiniCluster":
         return self.start()

@@ -262,6 +262,10 @@ def cmd_dfsadmin(args) -> int:
             c.set_quota(args.args[1], space_quota=int(args.args[0]))
         elif args.op == "-clrQuota":
             c.set_quota(args.args[0])
+        elif args.op == "-setBalancerBandwidth":
+            n = c._call("set_balancer_bandwidth",
+                        bytes_per_s=int(args.args[0]))
+            print(f"bandwidth {args.args[0]} B/s queued to {n} datanodes")
         elif args.op == "-recoverLease":
             ok = c._call("recover_lease", path=args.args[0])
             print("recovered" if ok else "not recovered")

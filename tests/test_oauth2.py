@@ -1,0 +1,161 @@
+"""OAuth2 for the WebHDFS gateway (web/oauth2/AccessTokenProvider.java,
+ConfCredentialBasedAccessTokenProvider, ConfRefreshTokenBased...): client
+providers fetch bearer tokens from an IdP; the gateway validates bearers by
+RFC 7662 introspection and uses the introspected identity.  A stub IdP
+drives the whole path — no external identity provider needed."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
+
+import pytest
+
+from hdrf_tpu.client.oauth2 import (
+    ConfCredentialBasedAccessTokenProvider,
+    ConfRefreshTokenBasedAccessTokenProvider)
+from hdrf_tpu.server.http_gateway import HttpGateway
+from hdrf_tpu.testing.minicluster import MiniCluster
+
+
+class StubIdP:
+    """Tiny OAuth2 server: /token (client_credentials + refresh_token
+    grants) and /introspect (RFC 7662)."""
+
+    def __init__(self):
+        self.issued: dict[str, str] = {}       # access token -> username
+        self.grants_served: list[str] = []
+        idp = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                form = {k: v[0] for k, v in
+                        parse_qs(self.rfile.read(n).decode()).items()}
+                if self.path == "/token":
+                    grant = form.get("grant_type", "")
+                    idp.grants_served.append(grant)
+                    if grant == "client_credentials" and \
+                            form.get("client_secret") == "s3cret":
+                        tok = f"at-{len(idp.issued)}"
+                        idp.issued[tok] = form["client_id"]
+                        return self._json({"access_token": tok,
+                                           "expires_in": 3600})
+                    if grant == "refresh_token" and \
+                            form.get("refresh_token") == "refresh-ok":
+                        tok = f"at-{len(idp.issued)}"
+                        idp.issued[tok] = form["client_id"]
+                        return self._json({"access_token": tok,
+                                           "expires_in": 120})
+                    return self._json({"error": "invalid_grant"}, 400)
+                if self.path == "/introspect":
+                    user = idp.issued.get(form.get("token", ""))
+                    return self._json({"active": user is not None,
+                                       **({"username": user} if user
+                                          else {})})
+                self._json({"error": "not found"}, 404)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.addr = self._server.server_address
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def url(self, p):
+        return f"http://{self.addr[0]}:{self.addr[1]}{p}"
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@pytest.fixture()
+def stack():
+    idp = StubIdP()
+    with MiniCluster(n_datanodes=1, replication=1) as mc:
+        gw = HttpGateway(mc.namenode.addr,
+                         oauth2_introspect_url=idp.url("/introspect"),
+                         gate_token_issue=True).start()
+        try:
+            yield idp, gw, mc
+        finally:
+            gw.stop()
+            idp.stop()
+
+
+def _get(url, bearer=None):
+    req = urllib.request.Request(url)
+    if bearer:
+        req.add_header("Authorization", f"Bearer {bearer}")
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_credential_provider_and_bearer_auth(stack):
+    idp, gw, mc = stack
+    prov = ConfCredentialBasedAccessTokenProvider(
+        idp.url("/token"), client_id="alice", client_secret="s3cret")
+    tok = prov.access_token()
+    assert prov.access_token() == tok          # cached, one grant served
+    assert idp.grants_served == ["client_credentials"]
+    base = f"http://{gw.addr[0]}:{gw.addr[1]}/webhdfs/v1"
+    st, out = _get(f"{base}/?op=GETHOMEDIRECTORY", bearer=tok)
+    assert st == 200
+    assert out["Path"] == "/user/alice"        # introspected identity
+
+
+def test_refresh_token_provider(stack):
+    idp, gw, _ = stack
+    prov = ConfRefreshTokenBasedAccessTokenProvider(
+        idp.url("/token"), client_id="bob", refresh_token="refresh-ok")
+    tok = prov.access_token()
+    base = f"http://{gw.addr[0]}:{gw.addr[1]}/webhdfs/v1"
+    st, out = _get(f"{base}/?op=GETHOMEDIRECTORY", bearer=tok)
+    assert out["Path"] == "/user/bob"
+
+
+def test_invalid_bearer_rejected(stack):
+    _, gw, _ = stack
+    base = f"http://{gw.addr[0]}:{gw.addr[1]}/webhdfs/v1"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{base}/?op=GETHOMEDIRECTORY", bearer="forged")
+    assert e.value.code == 401
+
+
+def test_token_issue_gated(stack):
+    """GETDELEGATIONTOKEN refuses unauthenticated callers when gated, and
+    mints for the INTROSPECTED identity when bearer-authenticated —
+    closing the claimed-user.name spoof."""
+    idp, gw, _ = stack
+    base = f"http://{gw.addr[0]}:{gw.addr[1]}/webhdfs/v1"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{base}/?op=GETDELEGATIONTOKEN&user.name=root")
+    assert e.value.code == 403
+    prov = ConfCredentialBasedAccessTokenProvider(
+        idp.url("/token"), client_id="carol", client_secret="s3cret")
+    st, out = _get(f"{base}/?op=GETDELEGATIONTOKEN&user.name=root",
+                   bearer=prov.access_token())
+    assert st == 200
+    from hdrf_tpu.server.http_gateway import decode_token
+    assert decode_token(out["Token"]["urlString"])["owner"] == "carol"
+
+
+def test_bearer_marker_cannot_be_spoofed_via_query(stack):
+    """'?_bearer=1' in the URL must not impersonate an authenticated
+    caller past the token-issue gate."""
+    _, gw, _ = stack
+    base = f"http://{gw.addr[0]}:{gw.addr[1]}/webhdfs/v1"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{base}/?op=GETDELEGATIONTOKEN&user.name=root&_bearer=1")
+    assert e.value.code == 403
