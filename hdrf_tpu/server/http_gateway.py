@@ -275,12 +275,23 @@ class HttpGateway:
                     # mint a token for any claimed user.name (the
                     # reference gates this leg behind Kerberos; plain
                     # simple-auth deployments leave the gate off)
-                    if gateway._gate_token_issue and \
-                            "_bearer" not in q and "delegation" not in q:
-                        return self._json(403, {
-                            "error": "AccessControlException",
-                            "message": "token issuance requires an "
-                                       "authenticated caller"})
+                    if gateway._gate_token_issue and "_bearer" not in q:
+                        # a delegation param only authenticates if the NN
+                        # VERIFIES it (decode_token alone checks nothing —
+                        # a forged {'owner':'root'} blob must not pass)
+                        ok = False
+                        if "delegation" in q:
+                            try:
+                                ok = c._nn.call(
+                                    "check_delegation_token",
+                                    token=decode_token(q["delegation"]))
+                            except Exception:  # noqa: BLE001
+                                ok = False
+                        if not ok:
+                            return self._json(403, {
+                                "error": "AccessControlException",
+                                "message": "token issuance requires an "
+                                           "authenticated caller"})
                     tok = c._nn.call("get_delegation_token",
                                      renewer=q.get("renewer", c.user),
                                      owner=c.user)

@@ -3071,7 +3071,7 @@ class NameNode:
         "incremental_block_report", "bad_block", "block_received",
         "commit_block_sync", "ha_state", "transition_to_active",
         "fetch_image", "get_delegation_token", "renew_delegation_token",
-        "cancel_delegation_token",
+        "cancel_delegation_token", "check_delegation_token",
     })
 
     def _rpc_auth_hook(self, method: str, dtoken: dict | None) -> None:
@@ -3099,6 +3099,17 @@ class NameNode:
             self._log(["dt_issue", ident, expiry])
             return {**ident, "password": self._dtokens.password(ident),
                     "expiry": expiry}
+
+    def rpc_check_delegation_token(self, token: dict) -> bool:
+        """Non-mutating verification (the gateway's token-issue gate asks
+        before treating a presented delegation token as authentication —
+        decoding alone proves nothing)."""
+        with self._lock:
+            try:
+                self._dtokens.verify(token)
+                return True
+            except Exception:  # noqa: BLE001 — verification IS the answer
+                return False
 
     def rpc_renew_delegation_token(self, token: dict) -> float:
         with self._lock:

@@ -273,10 +273,16 @@ class VolumeSet:
                 # a lazy-persisted shadow on a surviving volume rescues
                 # the block (RAM volume death is the exact scenario the
                 # lazy writer exists for) — fail ownership over instead
-                # of declaring it lost
+                # of declaring it lost.  Only a CURRENT-generation shadow
+                # counts: serving a stale pre-append copy silently would
+                # be worse than re-replicating from a healthy peer.
+                lost_meta = v.replicas.get_meta(bid)
+                lost_gs = lost_meta.gen_stamp if lost_meta else 0
                 for sv in self.volumes:
-                    if not sv.failed and sv.vol_id != vol_id \
-                            and sv.replicas.get_meta(bid) is not None:
+                    if sv.failed or sv.vol_id == vol_id:
+                        continue
+                    sm = sv.replicas.get_meta(bid)
+                    if sm is not None and sm.gen_stamp >= lost_gs:
                         self._where[bid] = sv.vol_id
                         _M.incr("blocks_rescued_by_shadow")
                         break
@@ -316,12 +322,17 @@ class VolumeSet:
                 meta = rv.replicas.get_meta(bid)
                 if meta is None:
                     continue
+                # an up-to-date shadow on ANY disk satisfies persistence —
+                # re-checking only the currently-most-free disk would
+                # duplicate the shadow each time that choice flips
+                if any(dm is not None and dm.gen_stamp >= meta.gen_stamp
+                       for dm in (dv.replicas.get_meta(bid)
+                                  for dv in disks)):
+                    continue
                 dv = max(disks, key=lambda v: v.free_estimate())
-                dm = dv.replicas.get_meta(bid)
-                if dm is None or dm.gen_stamp < meta.gen_stamp:
-                    dv.replicas.adopt(meta, rv.replicas.read_data(bid))
-                    persisted += 1
-                    _M.incr("lazy_persisted")
+                dv.replicas.adopt(meta, rv.replicas.read_data(bid))
+                persisted += 1
+                _M.incr("lazy_persisted")
             while rv.used_bytes() > ram_capacity:
                 flipped = False
                 for bid, gs, _ln in rv.replicas.block_report():

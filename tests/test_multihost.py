@@ -1,0 +1,47 @@
+"""Multi-host launcher (parallel/launch.py): jax.distributed over N real
+OS processes on a CPU mesh, running the REAL variable-chunk sharded
+pipeline and asserting oracle bit-identity on every rank — the deployable
+form of SURVEY §2.4's multi-chip reduction (the reference's MPI/NCCL
+process-group bring-up, re-expressed).
+
+Spawning JAX twice makes this the suite's slowest file; the subprocess
+environment mirrors conftest's clean-CPU relaunch recipe."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_cpu_mesh_oracle_identity(tmp_path):
+    from hdrf_tpu.utils.cleanenv import clean_cpu_env
+
+    port = _free_port()
+    env = clean_cpu_env(2)   # the canonical clean-CPU child recipe
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "hdrf_tpu.parallel.launch",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--nprocs", "2", "--rank", str(rank),
+             "--selftest", "1"],
+            env=env, cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for rank in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=280)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert "oracle_match=True" in out, f"rank {rank}:\n{out}"
+        assert "devices=4" in out, f"rank {rank} saw wrong mesh:\n{out}"

@@ -159,3 +159,18 @@ def test_bearer_marker_cannot_be_spoofed_via_query(stack):
     with pytest.raises(urllib.error.HTTPError) as e:
         _get(f"{base}/?op=GETDELEGATIONTOKEN&user.name=root&_bearer=1")
     assert e.value.code == 403
+
+
+def test_forged_delegation_param_cannot_pass_gate(stack):
+    """A base64/msgpack blob claiming owner=root is NOT authentication:
+    the gate verifies the delegation token with the NameNode."""
+    import base64
+    import msgpack
+    _, gw, _ = stack
+    forged = base64.urlsafe_b64encode(
+        msgpack.packb({"owner": "root", "seq": 1, "key_id": 1,
+                       "renewer": "", "password": b"x" * 32})).decode()
+    base = f"http://{gw.addr[0]}:{gw.addr[1]}/webhdfs/v1"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{base}/?op=GETDELEGATIONTOKEN&delegation={forged}")
+    assert e.value.code == 403
