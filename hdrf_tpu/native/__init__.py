@@ -87,6 +87,9 @@ def _load() -> ctypes.CDLL:
                                        _u8p, ctypes.c_uint64, _u8p]
         lib.hdrf_aead_open.restype = ctypes.c_int
         lib.hdrf_crc32c_chunks.argtypes = [_u8p, ctypes.c_uint64, ctypes.c_uint64, _u32p]
+        lib.hdrf_gather_ranges.argtypes = [_u8p, ctypes.c_uint64, _u64p,
+                                           _u64p, _u8p]
+        lib.hdrf_gather_ranges.restype = ctypes.c_uint64
         _lib = lib
         return lib
 
@@ -287,3 +290,21 @@ def crc32c_chunks(data: bytes | np.ndarray, chunk_size: int) -> np.ndarray:
     out = np.empty(max(n, 1), dtype=np.uint32)
     _load().hdrf_crc32c_chunks(_ptr(a, _u8p), a.size, chunk_size, _ptr(out, _u32p))
     return out[:n]
+
+
+def gather_ranges(data: bytes | np.ndarray, starts: np.ndarray,
+                  lens: np.ndarray) -> np.ndarray:
+    """Concatenate [start, start+len) ranges of ``data`` into one buffer —
+    the commit path's chunk-byte shuffle (threadedStorer's per-chunk
+    ByteBuffer copies, DataDeduplicator.java:652-845) in one native pass."""
+    a = _as_u8(data)
+    ss = np.ascontiguousarray(starts, dtype=np.uint64)
+    ls = np.ascontiguousarray(lens, dtype=np.uint64)
+    if ss.shape != ls.shape:
+        raise ValueError("starts/lens shape mismatch")
+    if ss.size and int((ss + ls).max()) > a.size:
+        raise ValueError("range exceeds data buffer")
+    out = np.empty(int(ls.sum()), dtype=np.uint8)
+    _load().hdrf_gather_ranges(_ptr(a, _u8p), ss.size, _ptr(ss, _u64p),
+                               _ptr(ls, _u64p), _ptr(out, _u8p))
+    return out

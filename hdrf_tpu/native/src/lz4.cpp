@@ -26,6 +26,41 @@ inline uint32_t hash4(uint32_t v) {
   return (v * 2654435761u) >> (32 - HASH_LOG);
 }
 
+inline uint64_t read64(const uint8_t *p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+
+// First p in [p, lim) with read32(p) == read32(p - off), else nullptr.
+// Word-at-a-time: one 8-byte XOR covers match starts p..p+4 (the zero-byte
+// mask trick finds 4 consecutive equal bytes), ~5x fewer loads than the
+// byte loop it replaces.  Caller guarantees p >= src+off and
+// lim <= iend - MFLIMIT, so the 8-byte loads never pass the buffer end.
+inline const uint8_t *scan_eq4(const uint8_t *p, const uint8_t *lim,
+                               uint32_t off) {
+  constexpr uint64_t LO7 = 0x7F7F7F7F7F7F7F7FULL;
+  while (p + 5 <= lim) {
+    uint64_t d = read64(p) ^ read64(p - off);
+    if (d == 0) return p;
+    // byte i equal <=> byte i of d zero; need 4 consecutive zero bytes.
+    // EXACT per-byte zero mask: additions are confined to the low 7 bits
+    // of each byte, so no cross-byte borrow can flag a non-zero byte
+    // (the classic (d-0x01..)&~d&0x80.. trick is NOT per-byte exact —
+    // its borrow propagates past a true zero byte and falsely flags
+    // 0x01 bytes above it, which emitted corrupt matches).
+    uint64_t t = (d & LO7) + LO7;
+    uint64_t z = ~(t | d | LO7);                // bit 8i+7 = byte i zero
+    uint64_t zb = z >> 7;                       // bit 8i   = byte i zero
+    uint64_t m = zb & (zb >> 8) & (zb >> 16) & (zb >> 24);
+    if (m) return p + (__builtin_ctzll(m) >> 3);
+    p += 5;
+  }
+  for (; p < lim; p++)
+    if (read32(p) == read32(p - off)) return p;
+  return nullptr;
+}
+
 // Write a length with 255-run extension bytes.
 inline uint8_t *write_len_ext(uint8_t *op, uint64_t len) {
   while (len >= 255) { *op++ = 255; len -= 255; }
@@ -212,8 +247,15 @@ uint64_t hdrf_lz4_emit(const uint8_t *src, uint64_t srclen, const int32_t *pos,
   uint32_t rep = 0, rep2 = 0;       // last two DISTINCT emitted offsets:
   // periodic row data alternates offsets (row-period rowid match vs the
   // period-minus-block filler match), and each re-entry needs its own
-  uint32_t rep_at_scan = 0, rep2_at_scan = 0;
-  const uint8_t *probe_scan = src;  // probe trial resumes here (monotone)
+  // Per-probe monotone scanners (vectorized probe trial): each slot walks
+  // the input once with the word-at-a-time scan_eq4, caching its next hit.
+  // Slots 2-4 (constant offsets 1/2/4) never rescan ground; slots 0/1
+  // restart from the anchor when their offset changes — semantically
+  // identical to the global rescan-on-new-offset rule they replace (a
+  // re-scan with unchanged constant offsets can find nothing new).
+  struct PSlot { uint32_t off; const uint8_t *scanned; const uint8_t *hit; };
+  PSlot slots[5] = {{0, src, nullptr}, {0, src, nullptr},
+                    {1, src, nullptr}, {2, src, nullptr}, {4, src, nullptr}};
   while (anchor < mflimit) {
     uint64_t acur = uint64_t(anchor - src);
     // Drop records whose verified span (+ slack for under-estimation) is
@@ -225,29 +267,39 @@ uint64_t hdrf_lz4_emit(const uint8_t *src, uint64_t srclen, const int32_t *pos,
     const uint8_t *rep_hit = nullptr;
     uint32_t hit_off = 0;
     {
-      if (rep != rep_at_scan || rep2 != rep2_at_scan) {
-        // a new offset invalidates previously "clean" ground: rescan the
-        // window from the anchor with the fresh probe set
-        probe_scan = anchor;
-        rep_at_scan = rep;
-        rep2_at_scan = rep2;
+      if (slots[0].off != rep) {
+        slots[0].off = rep; slots[0].scanned = anchor; slots[0].hit = nullptr;
       }
-      const uint32_t probes[5] = {rep, rep2, 1, 2, 4};
-      const uint8_t *p = probe_scan > anchor ? probe_scan : anchor;
+      if (slots[1].off != rep2) {
+        slots[1].off = rep2; slots[1].scanned = anchor; slots[1].hit = nullptr;
+      }
       const uint8_t *lim = rbase + LAZY_PROBE < mflimit
                                ? rbase + LAZY_PROBE : mflimit;
-      for (; p < lim && !rep_hit; p++) {
-        uint64_t at = uint64_t(p - src);
-        uint32_t w = read32(p);
-        for (int k = 0; k < 5; k++) {
-          uint32_t off = probes[k];
-          if (off == 0 || at < off) continue;
-          if (k >= 2 && (off == rep || off == rep2)) continue;  // dedup
-          if (k == 1 && off == rep) continue;
-          if (w == read32(p - off)) { rep_hit = p; hit_off = off; break; }
+      for (int k = 0; k < 5; k++) {
+        uint32_t off = slots[k].off;
+        if (off == 0) continue;
+        if (k >= 2 && (off == rep || off == rep2)) continue;  // dedup
+        if (k == 1 && off == rep) continue;
+        const uint8_t *start = anchor;
+        if (src + off > start) start = src + off;
+        if (slots[k].hit != nullptr && slots[k].hit < start) {
+          // cached hit consumed/passed: unscanned ground resumes at start
+          slots[k].hit = nullptr;
+          slots[k].scanned = start;
+        } else if (slots[k].hit == nullptr && slots[k].scanned < start) {
+          slots[k].scanned = start;
+        }
+        if (slots[k].hit == nullptr && slots[k].scanned < lim) {
+          const uint8_t *h = scan_eq4(slots[k].scanned, lim, off);
+          slots[k].scanned = h ? h : lim;
+          slots[k].hit = h;
+        }
+        if (slots[k].hit != nullptr && slots[k].hit < lim &&
+            (rep_hit == nullptr || slots[k].hit < rep_hit)) {
+          rep_hit = slots[k].hit;   // strict < : position ties keep the
+          hit_off = off;            // lowest-k probe, as the byte loop did
         }
       }
-      probe_scan = rep_hit ? rep_hit : lim;
     }
     const uint8_t *base = rep_hit && rep_hit < rbase ? rep_hit : rbase;
     const uint64_t LAZY = (rep_hit && rep_hit < rbase) ? LAZY_PROBE

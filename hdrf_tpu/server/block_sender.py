@@ -44,6 +44,13 @@ class BlockSender:
             return cached  # pinned logical bytes: no disk, no reconstruction
         meta = dn.replicas.get_meta(block_id)
         if meta is None:
+            # PROVIDED replica: bytes live in the external store the alias
+            # map points at (FileRegion -> ProvidedStorageLocation)
+            with dn.read_slot():
+                data = dn.aliasmap.read_bytes(block_id, offset, length)
+            if data is not None:
+                _M.incr("provided_serves")
+                return data
             raise KeyError(f"block {block_id} not on this datanode")
         scheme = dn.scheme(meta.scheme)
         stored = dn.replicas.read_data(block_id) if meta.physical_len else b""
@@ -63,7 +70,9 @@ class BlockSender:
             sp.annotate("block_id", block_id)
             try:
                 meta = dn.replicas.get_meta(block_id)
-                if meta is None:
+                region = (dn.aliasmap.read(block_id) if meta is None
+                          else None)
+                if meta is None and region is None:
                     raise KeyError(f"block {block_id} not on this datanode")
                 data = self.read_logical(block_id, offset, length)
             except Exception as e:  # noqa: BLE001 — status crosses the wire
@@ -72,10 +81,12 @@ class BlockSender:
                 _M.incr("read_errors")
                 return
             send_frame(sock, {"status": 0, "length": len(data),
-                              "logical_len": meta.logical_len,
+                              "logical_len": (meta.logical_len if meta
+                                              else region.length),
                               "offset": offset,
-                              "checksum_chunk": meta.checksum_chunk,
-                              "checksums": meta.checksums})
+                              "checksum_chunk": (meta.checksum_chunk if meta
+                                                 else 64 * 1024),
+                              "checksums": (meta.checksums if meta else [])})
             dt.stream_bytes(sock, data, dn.config.packet_size)
             _M.incr("blocks_served")
             _M.incr("bytes_served", len(data))

@@ -189,6 +189,13 @@ class DataNode:
         self._read_sem = threading.Semaphore(red.max_concurrent_reads)
         self._direct_sem = threading.Semaphore(red.max_concurrent_direct)
         self.cache = PinnedCache(config.cache_capacity)
+        # provided storage (aliasmap/InMemoryAliasMap.java): blocks whose
+        # bytes live in an external store; persisted regions are reported
+        # as PROVIDED replicas and served through the read path
+        from hdrf_tpu.storage.aliasmap import InMemoryAliasMap
+
+        self.aliasmap = InMemoryAliasMap(
+            os.path.join(config.data_dir, "aliasmap"))
         self.dn_id = dn_id or f"dn-{uuid.uuid4().hex[:8]}"
         from hdrf_tpu.proto.rpc import normalize_addrs
 
@@ -450,6 +457,23 @@ class DataNode:
                                   "gen_stamp": meta.gen_stamp if meta else -1,
                                   "rbw": self.replicas.is_rbw(
                                       fields["block_id"])})
+            elif op == "alias_add":
+                # provided-storage mount push (the live-cluster form of
+                # the reference's offline alias-map generation): persist
+                # the regions, report them immediately via IBR.  Gated by
+                # per-region WRITE block tokens (minted by the superuser-
+                # only rpc_provide_file) — without the check, anyone with
+                # DN network access could repoint provided blocks at
+                # arbitrary local files
+                from hdrf_tpu.storage.aliasmap import FileRegion
+                regions = [FileRegion.unpack(v) for v in fields["regions"]]
+                tokens = fields.get("tokens") or [None] * len(regions)
+                for reg, tok in zip(regions, tokens):
+                    self.tokens.verify(tok, reg.block_id, "w")
+                self.aliasmap.write(regions)
+                for reg in regions:
+                    self.notify_block_received(reg.block_id, reg.length, 0)
+                send_frame(sock, {"ok": True, "count": len(regions)})
             elif op == "reconfigure":
                 send_frame(sock, self.reconfigure(fields.get("key", ""),
                                                   fields.get("value")))
@@ -490,6 +514,19 @@ class DataNode:
 
         meta = self.replicas.get_meta(fields["block_id"])
         if meta is None:
+            # PROVIDED replica: no stored chunk CRCs — compute them from
+            # the external bytes (BlockChecksumHelper recomputes for
+            # replicas without meta the same way)
+            data = self.aliasmap.read_bytes(fields["block_id"])
+            if data is not None:
+                from hdrf_tpu import native
+                crcs = [int(c) for c in native.crc32c_chunks(
+                    data, self.checksum_chunk)]
+                send_frame(sock, {"status": 0,
+                                  "checksum_chunk": self.checksum_chunk,
+                                  "checksums": crcs,
+                                  "logical_len": len(data)})
+                return
             send_frame(sock, {"status": 1, "error": "KeyError",
                               "message": "no such block"})
             return
@@ -523,6 +560,8 @@ class DataNode:
 
     def _send_block_report(self, nn: RpcClient | None = None) -> None:
         report = [list(t) for t in self.replicas.block_report()]
+        report.extend([r.block_id, 0, r.length, "PROVIDED"]
+                      for r in self.aliasmap.list())
         for c in ([nn] if nn else self._nns):
             pool = self._pool_of.get(id(c))
             rows = (report if pool is None
@@ -623,6 +662,12 @@ class DataNode:
     def _execute(self, cmd: dict) -> None:
         """NN command execution (BPServiceActor.processCommand analog)."""
         if cmd["cmd"] == "invalidate":
+            # provided entries purge as ONE map rewrite, not one per
+            # block (each remove persists + fsyncs the whole map)
+            prov = [b for b in cmd["block_ids"]
+                    if self.aliasmap.read(b) is not None]
+            if prov:
+                self.aliasmap.remove(prov)
             for bid in cmd["block_ids"]:
                 self._invalidate(bid)
         elif cmd["cmd"] == "replicate":
@@ -821,6 +866,8 @@ class DataNode:
     def _invalidate(self, block_id: int) -> None:
         self.cache.unpin(block_id)
         self._sc.registry.revoke(block_id)  # cached client fds must drop
+        if self.aliasmap.read(block_id) is not None:
+            self.aliasmap.remove([block_id])  # provided mount entry
         meta = self.replicas.get_meta(block_id)
         if meta is None:
             return

@@ -590,6 +590,20 @@ class NameNode:
                 keep.append(bid)
                 pos += ln
             node.blocks = keep
+        elif op == "provide":
+            _, path, uri, length, bids, mtime = rec
+            parent, name = self._parent_of(path, create=True)
+            bs = self.config.block_size
+            node = FileNode(1, "direct", list(bids), True, mtime,
+                            inode_id=self._alloc_inode())
+            parent[name] = node
+            p = "/" + "/".join(self._parts(path))
+            for i, bid in enumerate(bids):
+                self._blocks[bid] = BlockInfo(
+                    bid, 0, min(bs, length - i * bs), p)
+            if bids:
+                self._next_block_id = max(self._next_block_id,
+                                          max(bids) + 1)
         elif op == "fsync":
             # hflush/hsync visible-length persist (FSNamesystem.fsync):
             # only ever grows — a lagging retry must not shrink it
@@ -940,6 +954,10 @@ class NameNode:
                 raise FileExistsError(f"{rec[1]}: {name} is a file")
         elif op == "create":
             self._peek_parent(rec[1])
+        elif op == "provide":
+            parent, name = self._peek_parent(rec[1])
+            if parent is not None and name in parent:
+                raise FileExistsError(rec[1])
         elif op in ("add_block", "add_block_group", "abandon_block",
                     "complete", "fsync"):
             self._file(rec[1])
@@ -2587,6 +2605,30 @@ class NameNode:
                             info.locations.add(dn_id)
 
     # ------------------------------------------------------------- admin RPC
+
+    def rpc_provide_file(self, path: str, uri: str, length: int) -> dict:
+        """Register a PROVIDED file: a complete namespace entry whose
+        blocks' bytes live in an external store (the provided-storage
+        half of aliasmap/InMemoryAliasMapProtocol; the reference builds
+        this mapping offline with the fsimage image-writer).  Returns the
+        FileRegions the caller pushes to DataNodes (``alias_add``), which
+        then report PROVIDED replicas.  Superuser-only."""
+        with self._lock:
+            self._check_access(path, super_only=True)
+            if length < 0:
+                raise ValueError("length must be >= 0")
+            bs = self.config.block_size
+            nblocks = max(-(-length // bs), 1) if length else 0
+            bids = list(range(self._next_block_id,
+                              self._next_block_id + nblocks))
+            self._log(["provide", path, uri, length, bids, time.time()])
+            _M.incr("provided_files")
+            return {"regions": [
+                [bid, uri, i * bs, min(bs, length - i * bs)]
+                for i, bid in enumerate(bids)],
+                # per-region WRITE tokens gate the DN-side alias_add push
+                "tokens": ([self._tokens.mint(bid, "w") for bid in bids]
+                           if self._tokens else None)}
 
     def rpc_set_balancer_bandwidth(self, bytes_per_s: int) -> int:
         """Broadcast a background-transfer bandwidth cap to every DataNode

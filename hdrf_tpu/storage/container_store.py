@@ -156,6 +156,62 @@ class ContainerStore:
         _M.incr("chunks_appended", len(chunks))
         return out
 
+    def append_ranges(self, data, starts, lens, on_seal=None,
+                      sync: bool = True) -> list[tuple[int, int, int]]:
+        """``append_chunks`` for chunks that are RANGES of one buffer (the
+        dedup commit's shape): byte movement runs as one native
+        gather_ranges per container segment instead of n memoryview
+        slices + list appends + a join — the commit half's Python byte
+        shuffling (measured ~1.2 s per 512 MiB of TeraGen-density chunks
+        on the 1-vCPU host).  Rollover semantics identical to
+        append_chunks: a chunk that doesn't fit seals the open container
+        first; an oversized chunk lands alone in an empty one."""
+        import numpy as np
+
+        from hdrf_tpu import native
+
+        n = int(len(starts))
+        if n == 0:
+            return []
+        starts = np.ascontiguousarray(starts, dtype=np.uint64)
+        lens = np.ascontiguousarray(lens, dtype=np.uint64)
+        with self._alloc_lock:
+            lane = self._lanes[self._rr % len(self._lanes)]
+            self._rr += 1
+        out_cid = np.empty(n, np.int64)
+        out_off = np.empty(n, np.int64)
+        csum = np.concatenate([[0], np.cumsum(lens, dtype=np.int64)])
+        with lane.lock:
+            i = 0
+            while i < n:
+                if lane.image is None:
+                    self._open_locked(lane)
+                cap = self._container_size - lane.size
+                j = int(np.searchsorted(csum, csum[i] + cap,
+                                        side="right")) - 1
+                if j <= i:
+                    if lane.size > 0:
+                        self._seal_locked(lane, on_seal)
+                        self._open_locked(lane)
+                        continue
+                    j = i + 1
+                blob = native.gather_ranges(data, starts[i:j],
+                                            lens[i:j]).tobytes()
+                if lane.fh is not None:
+                    lane.fh.write(blob)
+                out_cid[i:j] = lane.container_id
+                out_off[i:j] = lane.size + (csum[i:j] - csum[i])
+                lane.image += blob
+                lane.size += int(csum[j] - csum[i])
+                i = j
+            if lane.fh is not None:
+                lane.fh.flush()
+                if sync and self._fsync:
+                    os.fsync(lane.fh.fileno())
+        _M.incr("chunks_appended", n)
+        return [(int(c), int(o), int(ln))
+                for c, o, ln in zip(out_cid, out_off, lens)]
+
     def sync_lanes(self) -> None:
         """Flush (and, under the fsync policy, fsync) every open lane — the
         group-commit durability barrier.  A no-op in memory-resident mode,

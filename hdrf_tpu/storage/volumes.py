@@ -434,6 +434,12 @@ class MultiContainerStore:
         return vol.containers.append_chunks(chunks, on_seal=on_seal,
                                             sync=sync)
 
+    def append_ranges(self, data, starts, lens, on_seal=None,
+                      sync: bool = True):
+        vol = self._vs._choose_volume(None, exclude_ram=True)
+        return vol.containers.append_ranges(data, starts, lens,
+                                            on_seal=on_seal, sync=sync)
+
     def sync_lanes(self) -> None:
         for v in self._vs._alive():
             v.containers.sync_lanes()

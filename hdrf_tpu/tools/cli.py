@@ -262,6 +262,33 @@ def cmd_dfsadmin(args) -> int:
             c.set_quota(args.args[1], space_quota=int(args.args[0]))
         elif args.op == "-clrQuota":
             c.set_quota(args.args[0])
+        elif args.op == "-provide":
+            # mount an external file as a PROVIDED-storage HDFS file:
+            # NN registers the namespace half, then every live DN gets
+            # the FileRegions (aliasmap/InMemoryAliasMapProtocol's
+            # write half over the DN op)
+            local, hpath = args.args
+            local = os.path.abspath(local)
+            length = os.path.getsize(local)
+            out = c._call("provide_file", path=hpath,
+                          uri=f"file://{local}", length=length)
+            pushed = 0
+            for d in c.datanode_report():
+                if not d["alive"]:
+                    continue
+                addr = f"{d['addr'][0]}:{d['addr'][1]}"
+                try:
+                    r = _dn_call(addr, "alias_add",
+                                 regions=out["regions"],
+                                 tokens=out.get("tokens"))
+                    pushed += 1 if r.get("ok") else 0
+                except (OSError, ConnectionError) as e:
+                    # a DN that died since its last heartbeat must not
+                    # abort the mount mid-push; the rest keep serving
+                    print(f"  warning: {d['dn_id']} unreachable ({e})",
+                          file=sys.stderr)
+            print(f"provided {hpath} ({length} bytes, "
+                  f"{len(out['regions'])} regions) on {pushed} datanodes")
         elif args.op == "-setBalancerBandwidth":
             n = c._call("set_balancer_bandwidth",
                         bytes_per_s=int(args.args[0]))

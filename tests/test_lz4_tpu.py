@@ -179,3 +179,24 @@ class TestStitchedParallelLz4:
         assert 0 < toff < len(stream)
         if tlit:
             assert stream[-tlit:] == a.tobytes()[-tlit:]
+
+
+def test_emit_adversarial_low_bytes_roundtrip():
+    """Regression for the probe-scan word-scan: low-byte-biased data (runs
+    of 0x00/0x01) is where a borrow-corrupted zero-byte mask emitted
+    matches whose bytes did NOT match — every emit output must decompress
+    back to the exact input."""
+    import numpy as np
+
+    from hdrf_tpu import native
+    from hdrf_tpu.ops.lz4_tpu import TpuLz4
+
+    rng = np.random.default_rng(99)
+    tl = TpuLz4()
+    for trial in range(4):
+        n = 1 << 20
+        a = rng.integers(0, 4, n, dtype=np.uint8)      # dense 0x00-0x03
+        a[:: 7] = rng.integers(0, 256, a[::7].size, dtype=np.uint8)
+        out = tl.compress(a)
+        assert native.lz4_decompress(out, n) == a.tobytes(), \
+            f"trial {trial}: corrupt emit stream"

@@ -1,0 +1,182 @@
+"""Provided storage / alias map (aliasmap/InMemoryAliasMap.java,
+common/FileRegion.java:34): files whose bytes live in an external store,
+registered in the namespace, mapped block->byte-range by the DN-side alias
+map, reported as PROVIDED replicas, and served through the normal read
+path."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from hdrf_tpu.storage.aliasmap import FileRegion, InMemoryAliasMap
+from hdrf_tpu.testing.minicluster import MiniCluster
+
+
+def test_aliasmap_persistence(tmp_path):
+    p = str(tmp_path / "amap")
+    m = InMemoryAliasMap(p)
+    m.write([FileRegion(7, "file:///x", 0, 100),
+             FileRegion(8, "file:///x", 100, 50)])
+    m2 = InMemoryAliasMap(p)          # reload from disk
+    assert m2.read(7).length == 100 and m2.read(8).offset == 100
+    m2.remove([7])
+    assert InMemoryAliasMap(p).read(7) is None
+
+
+def test_aliasmap_range_reads(tmp_path):
+    ext = tmp_path / "store.bin"
+    data = os.urandom(1000)
+    ext.write_bytes(data)
+    m = InMemoryAliasMap(str(tmp_path / "amap"))
+    m.write([FileRegion(1, f"file://{ext}", 100, 500)])
+    assert m.read_bytes(1) == data[100:600]
+    assert m.read_bytes(1, offset=10, length=20) == data[110:130]
+    assert m.read_bytes(1, offset=499, length=100) == data[599:600]
+    assert m.read_bytes(99) is None   # not provided
+
+
+@pytest.fixture()
+def cluster():
+    with MiniCluster(n_datanodes=2, replication=1, heartbeat_s=0.1,
+                     block_size=256 * 1024) as mc:
+        yield mc
+
+
+def _provide(mc, c, local: str, hpath: str):
+    out = c._call("provide_file", path=hpath,
+                  uri=f"file://{local}", length=os.path.getsize(local))
+    from hdrf_tpu.storage.aliasmap import FileRegion as FR
+    for dn in mc.datanodes:
+        dn.aliasmap.write([FR.unpack(v) for v in out["regions"]])
+        for v in out["regions"]:
+            dn.notify_block_received(v[0], v[3], 0)
+    return out
+
+
+def test_provided_file_reads_through_dfs(cluster, tmp_path):
+    data = os.urandom(700_000)        # 3 regions at 256 KiB blocks
+    ext = tmp_path / "external.bin"
+    ext.write_bytes(data)
+    with cluster.client() as c:
+        out = _provide(cluster, c, str(ext), "/mnt/ext")
+        assert len(out["regions"]) == 3
+        deadline = time.monotonic() + 10
+        while True:                   # wait for IBRs to land locations
+            try:
+                assert c.read("/mnt/ext") == data
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        # ranged read across a region boundary
+        assert c.read("/mnt/ext", offset=250_000, length=20_000) == \
+            data[250_000:270_000]
+        st = c.stat("/mnt/ext")
+        assert st["length"] == len(data) and st["complete"]
+
+
+def test_provided_survives_restarts(cluster, tmp_path):
+    data = os.urandom(100_000)
+    ext = tmp_path / "ext2.bin"
+    ext.write_bytes(data)
+    with cluster.client() as c:
+        _provide(cluster, c, str(ext), "/mnt/ext2")
+    cluster.restart_namenode()
+    cluster.stop_datanode(0)
+    cluster.restart_datanode(0)       # aliasmap reloads from disk
+    cluster.wait_for_datanodes(2)
+    with cluster.client() as c:
+        deadline = time.monotonic() + 15
+        while True:
+            try:
+                assert c.read("/mnt/ext2") == data
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+
+
+def test_provide_cli(cluster, tmp_path):
+    data = b"provided-by-cli" * 1000
+    ext = tmp_path / "cli.bin"
+    ext.write_bytes(data)
+    addr = f"{cluster.namenode.addr[0]}:{cluster.namenode.addr[1]}"
+    out = subprocess.run(
+        [sys.executable, "-m", "hdrf_tpu.tools.cli", "dfsadmin",
+         "--namenode", addr, "-provide", str(ext), "/mnt/cli"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert "provided /mnt/cli" in out.stdout, out.stdout + out.stderr
+    with cluster.client() as c:
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                assert c.read("/mnt/cli") == data
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+
+
+def test_provided_delete_cleans_aliasmap(cluster, tmp_path):
+    data = os.urandom(50_000)
+    ext = tmp_path / "del.bin"
+    ext.write_bytes(data)
+    with cluster.client() as c:
+        out = _provide(cluster, c, str(ext), "/mnt/del")
+        bid = out["regions"][0][0]
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                assert c.read("/mnt/del") == data
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        assert c.delete("/mnt/del")
+        deadline = time.monotonic() + 10
+        while any(dn.aliasmap.read(bid) is not None
+                  for dn in cluster.datanodes):
+            assert time.monotonic() < deadline, "aliasmap entry not purged"
+            time.sleep(0.2)
+
+
+def test_provided_file_checksum(cluster, tmp_path):
+    """getFileChecksum works on provided files: DNs recompute chunk CRCs
+    from the external bytes, and the composite equals crc32c(bytes)."""
+    from hdrf_tpu import native
+    data = os.urandom(300_000)
+    ext = tmp_path / "ck.bin"
+    ext.write_bytes(data)
+    with cluster.client() as c:
+        _provide(cluster, c, str(ext), "/mnt/ck")
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                fc = c.get_file_checksum("/mnt/ck")
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        assert fc["crc"] == native.crc32c(data)
+
+
+def test_alias_add_requires_token_when_secure(tmp_path):
+    """With block tokens on, a tokenless alias_add is refused — the DN-side
+    gate matching rpc_provide_file's superuser-only NN gate."""
+    from hdrf_tpu.tools.cli import _dn_call
+    with MiniCluster(n_datanodes=1, replication=1, secure=True) as mc:
+        dn = mc.datanodes[0]
+        addr = f"{dn.addr[0]}:{dn.addr[1]}"
+        with pytest.raises(Exception):
+            _dn_call(addr, "alias_add",
+                     regions=[[999, "file:///etc/hostname", 0, 10]],
+                     tokens=None)
+        assert dn.aliasmap.read(999) is None
