@@ -81,8 +81,17 @@ def test_hsync_survives_datanode_crash():
         mc.kill_datanode(0)
         mc.restart_datanode(0)
         mc.wait_for_datanodes(1)
+        import time
         with mc.client("reader") as r:
-            assert r.read("/synced") == data
+            deadline = time.monotonic() + 15
+            while True:   # the promoted replica's block report may lag
+                try:
+                    assert r.read("/synced") == data
+                    break
+                except (IOError, OSError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
 
 
 def test_unflushed_tail_not_visible(cluster):
