@@ -174,7 +174,12 @@ class BlockReceiver:
                 # replica length across the pipeline; a dropped prefix here
                 # would silently shrink that to zero).  Every buffered packet
                 # passed its CRC, so the prefix is a safe sync candidate.
-                if writer is not None and writer.bytes_written > 0:
+                if writer is not None and writer.bytes_written > 0 \
+                        and not dn._crashed:
+                    # _crashed: a crash simulation (MiniCluster
+                    # kill_datanode) — a dead process cannot finalize, and
+                    # doing so here would race the restarted DN's recovery
+                    # scan over the same directory
                     if tail:
                         crcs.append(native.crc32c(tail))
                     meta = writer.finalize(writer.bytes_written, "direct",
@@ -186,7 +191,10 @@ class BlockReceiver:
                 raise
             finally:
                 if writer is not None:
-                    writer.abort()
+                    if dn._crashed:
+                        writer.detach()   # crash sim: leave rbw + sidecar
+                    else:
+                        writer.abort()
                 if mirror_sock is not None:
                     mirror_sock.close()
 
@@ -298,7 +306,10 @@ class BlockReceiver:
                 writer.write(stored)
             meta = writer.finalize(len(data), scheme_name, crcs, dn.checksum_chunk)
         except Exception:
-            writer.abort()
+            if dn._crashed:
+                writer.detach()   # crash sim: dead processes delete nothing
+            else:
+                writer.abort()
             raise
         dn.notify_block_received(block_id, meta.logical_len, meta.gen_stamp)
         status = dt.ACK_SUCCESS
@@ -412,7 +423,10 @@ class BlockReceiver:
                 writer.write(stored)
             meta = writer.finalize(logical_len, scheme_name, list(crcs), cchunk)
         except Exception:
-            writer.abort()
+            if dn._crashed:
+                writer.detach()   # crash sim: dead processes delete nothing
+            else:
+                writer.abort()
             raise
         dn.notify_block_received(block_id, meta.logical_len, meta.gen_stamp)
         status = dt.ACK_SUCCESS

@@ -235,15 +235,26 @@ class DataNode:
         now0 = _time.monotonic()
         self._last_hb_ok = {id(nn): now0 for nn in self._nns}
 
+        # Crash simulation (MiniCluster.kill_datanode): when set, in-flight
+        # receivers tear down WITHOUT touching disk (a dead process can't
+        # finalize or delete replicas) — see BlockReceiver's teardown.
+        self._crashed = False
+        self._inflight = 0                       # active xceiver handlers
+        self._inflight_cv = threading.Condition()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
                 outer._conns.add(self.request)
+                with outer._inflight_cv:
+                    outer._inflight += 1
                 try:
                     outer._xceive(self.request)
                 finally:
                     outer._conns.discard(self.request)
+                    with outer._inflight_cv:
+                        outer._inflight -= 1
+                        outer._inflight_cv.notify_all()
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -335,6 +346,14 @@ class DataNode:
             except OSError:
                 pass
             s.close()
+
+    def await_xceivers(self, timeout: float = 5.0) -> bool:
+        """Wait for in-flight xceiver handlers to unwind (severed sockets
+        make them exit promptly).  kill_datanode uses this so a restart
+        over the same directory never races a dying handler's teardown."""
+        with self._inflight_cv:
+            return self._inflight_cv.wait_for(
+                lambda: self._inflight == 0, timeout)
 
     # --------------------------------------------------------------- helpers
 

@@ -145,6 +145,13 @@ class ReplicaWriter:
                 os.unlink(p)
         self._store._release_rbw(self.block_id)
 
+    def detach(self) -> None:
+        """Close WITHOUT deleting — the crash-simulation teardown: a dead
+        process leaves its rbw file and hflush sidecar on disk exactly as
+        they were, which is what restart promotion recovers from."""
+        self._fh.close()
+        self._store._release_rbw(self.block_id)
+
 
 class ReplicaStore:
     def __init__(self, directory: str):

@@ -227,13 +227,20 @@ class MiniCluster:
             self.datanodes[i] = None
 
     def kill_datanode(self, i: int) -> None:
-        """Abrupt death: close sockets without flushing (crash simulation)."""
+        """Abrupt death: close sockets without flushing (crash simulation).
+        ``_crashed`` is set FIRST so in-flight receivers die without
+        touching disk — a dead process cannot finalize partial replicas,
+        and a post-kill finalize would race a restarted DN's recovery."""
         dn = self.datanodes[i]
         if dn is not None:
+            dn._crashed = True
             dn._stop.set()
             dn._server.shutdown()
             dn._server.server_close()
             dn._sever_connections()
+            # in-flight handlers must UNWIND (crashed => no disk writes)
+            # before a restart may scan the same directory
+            dn.await_xceivers()
             self.datanodes[i] = None
 
     def restart_namenode(self) -> NameNode:
