@@ -13,7 +13,7 @@ dispatch; DFSAdmin, OfflineImageViewer / OfflineEditsViewer under
                            -chmod -chown -getfacl -setfacl -setfattr -getfattr
   mover                    migrate replicas to satisfy storage policies
   dfsadmin                 -report -savenamespace -metrics -slowPeers
-                           -movblock
+                           -movblock -setBalancerBandwidth -provide
                            -allowSnapshot -setQuota -setSpaceQuota -clrQuota
                            -safemode -decommission -decommissionStatus
                            -haState -transitionToActive
