@@ -296,6 +296,98 @@ def bench_recon(args) -> None:
         ctx.index.close()
 
 
+def bench_sort(args) -> None:
+    """Match-scan sort engine A/B: the Pallas fused bitonic network
+    (ops/sort_pallas.py) vs the ``jax.lax.sort`` reference, slope method —
+    k salted iterations inside ONE dispatch with a dependent readback, so
+    (T(k) - T(1)) / (k - 1) divides out the ~100 ms per-dispatch transport
+    constant (PERF_NOTES.md round 4).  On the CPU mesh only the XLA path
+    runs (Mosaic needs a real chip); ``--interpret`` forces the kernel
+    through the Pallas interpreter for correctness spot-checks, not
+    timing."""
+    import jax
+    import jax.numpy as jnp
+
+    from hdrf_tpu.ops import sort_pallas
+
+    rng = np.random.default_rng(13)
+    t, e = args.tiles, args.entries
+    stride, pos_bits = 2, int(e - 1).bit_length()
+    vals = jnp.asarray(rng.integers(0, 2**32, size=(t, e), dtype=np.uint32))
+    half = e // 2
+    idx = np.arange(e)
+    posn = jnp.asarray(np.where(idx < half, 2 * idx,
+                                2 * (idx - half) + 1)
+                       .astype(np.uint32))[None].repeat(t, axis=0)
+
+    impls = ["xla"]
+    if sort_pallas.use_pallas() or args.interpret:
+        impls.append("pallas")
+
+    def measure(build):
+        def timed(k):
+            f = jax.jit(build(k))
+            float(f(vals))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(args.repeats):
+                float(f(vals))  # dependent readback acks real completion
+            return (time.perf_counter() - t0) / args.repeats
+        t1, tk = timed(1), timed(args.inner)
+        return (tk - t1) / (args.inner - 1)
+
+    for impl in impls:
+        interp = args.interpret and impl == "pallas"
+
+        def build(k, impl=impl, interp=interp):
+            def f(v):
+                acc = jnp.uint32(0)
+                for i in range(k):
+                    # the salt defeats CSE between iterations
+                    d = sort_pallas.match_deltas(v ^ jnp.uint32(i), posn,
+                                                 stride, pos_bits,
+                                                 impl=impl,
+                                                 interpret=interp)
+                    acc += d[0, 0] + jnp.sum(d[:, -1])
+                return acc
+            return f
+
+        per = measure(build)
+        print(json.dumps({
+            "op": f"match_deltas [{impl}{'/interp' if interp else ''}]",
+            "entries": t * e, "ms_per_scan": round(per * 1e3, 3),
+            "MBps": round(t * e * stride / per / 2**20, 1)}))
+
+    for impl in impls:
+        interp = args.interpret and impl == "pallas"
+
+        def build(k, impl=impl, interp=interp):
+            def f(v):
+                acc = jnp.uint32(0)
+                for i in range(k):
+                    _, sv = sort_pallas.sort_rows(v ^ jnp.uint32(i), v,
+                                                  impl=impl,
+                                                  interpret=interp)
+                    acc += sv[0, 0] + jnp.sum(sv[:, -1])
+                return acc
+            return f
+
+        per = measure(build)
+        print(json.dumps({
+            "op": f"sort_rows [{impl}{'/interp' if interp else ''}]",
+            "entries": t * e, "ms_per_sort": round(per * 1e3, 3),
+            "Mkeys_per_s": round(t * e / per / 1e6, 1)}))
+
+    # Readback-size ledger: the packed record layout vs the full one at the
+    # production L3 width (deterministic; no device needed).
+    from hdrf_tpu.ops.lz4_tpu import _packed_len
+
+    p3 = 1 << 17
+    full, packed = 1 + 2 * p3, _packed_len(p3)
+    print(json.dumps({"op": "record readback", "p3": p3,
+                      "full_words": full, "packed_words": packed,
+                      "reduction_pct": round(100 * (1 - packed / full), 1)}))
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="hdrf-bench")
     sub = p.add_subparsers(dest="which", required=True)
@@ -316,6 +408,16 @@ def main(argv: list[str] | None = None) -> int:
     d.add_argument("--mb", type=int, default=64)
     d.add_argument("--backend", default="auto")
     d.set_defaults(fn=bench_reduction)
+    d = sub.add_parser("sort")
+    d.add_argument("--tiles", type=int, default=8)
+    d.add_argument("--entries", type=int, default=1 << 15)
+    d.add_argument("--inner", type=int, default=8,
+                   help="k for the slope method's long pass")
+    d.add_argument("--repeats", type=int, default=5)
+    d.add_argument("--interpret", action="store_true",
+                   help="run the Pallas kernel through the interpreter "
+                        "(correctness spot-check on the CPU mesh)")
+    d.set_defaults(fn=bench_sort)
     d = sub.add_parser("recon")
     d.add_argument("--mb", type=int, default=64)
     d.add_argument("--repeats", type=int, default=3)

@@ -14,7 +14,10 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:      # Python < 3.11: the tomli backport is the
+    import tomli as tomllib      # same parser under its pre-stdlib name
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -194,6 +197,13 @@ class DataNodeConfig:
     # (dfs.datanode.ram.disk.low.watermark analog, expressed as a cap).
     lazy_writer_interval_s: float = 3.0
     ram_disk_capacity: int = 64 * 1024 * 1024
+    # Provided-storage mount root: ``alias_add`` file:// URIs must resolve
+    # inside this directory or the region is rejected (without it, anyone
+    # holding a write token could alias a block to an arbitrary DN-local
+    # file — /etc/passwd disclosure through the ordinary read path).
+    # Empty = provided storage disabled for file:// URIs; "/" opts out of
+    # confinement explicitly.
+    provided_mount_root: str = ""
     reduction: ReductionConfig = field(default_factory=ReductionConfig)
 
 

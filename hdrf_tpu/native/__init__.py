@@ -74,6 +74,10 @@ def _load() -> ctypes.CDLL:
         lib.hdrf_lz4_compress_tail.restype = ctypes.c_uint64
         lib.hdrf_lz4_decompress.argtypes = [_u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64]
         lib.hdrf_lz4_decompress.restype = ctypes.c_uint64
+        lib.hdrf_lz4_unpack_records.argtypes = [
+            _u32p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, _i32p, _u32p]
+        lib.hdrf_lz4_unpack_records.restype = ctypes.c_uint64
         lib.hdrf_lz4_emit.argtypes = [_u8p, ctypes.c_uint64, _i32p, _u32p,
                                       ctypes.c_uint64, _u8p, ctypes.c_uint64]
         lib.hdrf_lz4_emit.restype = ctypes.c_uint64
@@ -223,6 +227,27 @@ def lz4_emit(data: bytes | np.ndarray, positions: np.ndarray,
     if n == 0:
         raise RuntimeError("lz4 emit failed")
     return out[:n].tobytes()
+
+
+def lz4_unpack_records(row: np.ndarray, p3: int, nv: int, stride: int,
+                       esc_slots: int):
+    """Decode the packed device record readback (see hdrf_lz4_unpack_records
+    and the ops/lz4_tpu._match_scan_impl layout docstring) into the
+    (positions, (offset << 16) | len) arrays lz4_emit consumes.  ``row`` is
+    the u32 body AFTER the 4-word header.  Returns (pos i32[nrec],
+    dl u32[nrec], nrec); nrec < nv means an escape lane overflowed on
+    device and the tail was not decodable."""
+    r = np.ascontiguousarray(row, dtype=np.uint32)
+    if r.size < p3 + p3 // 4 + 2 * esc_slots:
+        raise ValueError("packed record row too short")
+    if not 0 <= nv <= p3:
+        raise ValueError("invalid record count")
+    pos = np.empty(nv, dtype=np.int32)
+    dl = np.empty(nv, dtype=np.uint32)
+    nrec = _load().hdrf_lz4_unpack_records(
+        _ptr(r, _u32p), p3, nv, stride, esc_slots,
+        _ptr(pos, _i32p), _ptr(dl, _u32p))
+    return pos[:nrec], dl[:nrec], int(nrec)
 
 
 def lz4_decompress(data: bytes | np.ndarray, decompressed_size: int) -> bytes:

@@ -420,4 +420,56 @@ uint64_t hdrf_lz4_decompress(const uint8_t *src, uint64_t srclen, uint8_t *dst,
   return uint64_t(op - dst);
 }
 
+// Decode the delta-encoded device record readback (ops/lz4_tpu.py packed
+// layout) back into the (pos, (offset << 16) | len) records hdrf_lz4_emit
+// consumes.  `row` starts at the A array (the 4-word header is consumed by
+// the caller): A u32 x p3, B u32 x p3/4 (dpos low bytes, 4 per word), then
+// two esc_slots-wide escape lanes (absolute entry-unit positions / lengths,
+// record order).  All fields are in entry units (byte value / stride).
+//
+// Serial by necessity (each position is a prefix sum over deltas) but
+// trivially so: one pass, ~5 loads per record.  Returns the number of
+// records decoded — short of nv only when an escape lane overflowed on
+// device (the caller then rescans in the full layout, or truncates if the
+// device block is gone; truncation costs ratio, never correctness).
+uint64_t hdrf_lz4_unpack_records(const uint32_t *row, uint64_t p3,
+                                 uint64_t nv, uint64_t stride,
+                                 uint64_t esc_slots, int32_t *pos_out,
+                                 uint32_t *dl_out) {
+  const uint32_t *A = row;
+  const uint32_t *B = row + p3;
+  const uint32_t *E1 = B + p3 / 4;
+  const uint32_t *E2 = E1 + esc_slots;
+  uint64_t e1 = 0, e2 = 0;
+  uint64_t prev_u = 0;
+  uint64_t i = 0;
+  for (; i < nv; i++) {
+    uint32_t a = A[i];
+    uint32_t delta_u = a & 0x7FFF;
+    uint32_t len9 = (a >> 15) & 0x1FF;
+    uint32_t lo = (B[i >> 2] >> ((i & 3) * 8)) & 0xFF;
+    uint32_t dp16 = ((a >> 24) << 8) | lo;
+    uint64_t pos_u;
+    if (dp16 == 0xFFFF) {
+      if (e1 >= esc_slots) break;
+      pos_u = E1[e1++];
+    } else {
+      pos_u = prev_u + dp16;
+    }
+    uint32_t len_u;
+    if (len9 == 511) {
+      if (e2 >= esc_slots) break;
+      len_u = E2[e2++];
+    } else {
+      len_u = len9;
+    }
+    uint32_t mlen =
+        len_u == 32766 ? 65535 : uint32_t(len_u * stride + MIN_MATCH);
+    pos_out[i] = int32_t(pos_u * stride);
+    dl_out[i] = (uint32_t(delta_u * stride) << 16) | mlen;
+    prev_u = pos_u;
+  }
+  return i;
+}
+
 }  // extern "C"

@@ -37,10 +37,23 @@ the design avoids gathers entirely:
    second small pack sort + static prefix slice yields a bounded readback.
    Slice widths are jit-shape hints learned from the workload; overflow is
    detected exactly (total vs returned) and retried wider.
-5. One packed D2H: [total, positions..., (delta<<16|len)...] — O(sequences),
-   the irreducible cost of host-side serialization (the host already holds
-   the literal bytes; in the co-located deployment this is the stored
-   output, smaller than the compressed stream itself).
+5. One packed D2H, delta-encoded on device (the global pack sorts by
+   position, so records arrive ascending): per record one u32 of
+   (pos-delta hi8 | len9 | offset15) plus one u8 of pos-delta low bits —
+   5 B/record against the naive (pos u32, delta<<16|len u32) 8 B — with
+   two tiny escape lanes for the rare wide position gap (> 65535 entry
+   units) or long run length (>= 511 units).  ``native.lz4_unpack_records``
+   reconstructs the exact (pos, delta, len) triples on the host, so the
+   emitted stream is byte-identical to the unpacked layout (which remains
+   as the escape-overflow rescan shape).  O(sequences) either way — the
+   irreducible cost of host-side serialization.
+
+The two big per-supertile sorts and the whole delta pipeline between them
+run as ONE Pallas kernel on TPU (ops/sort_pallas.match_deltas: in-kernel
+key construction, fused neighbor compare, bitonic merge networks); the
+record pack sorts ride the same kernel (sort_pallas.sort_rows).  The
+``jax.lax.sort`` formulation is kept verbatim as the CPU-mesh fallback and
+the kernels' bit-identity oracle.
 
 The native emit re-verifies and exactly extends every record (the device's
 run-based length estimate undershoots when a nearer duplicate interrupts a
@@ -189,6 +202,22 @@ _BIG = 1 << 30
 _INVALID = np.int32(2**31 - 1)
 
 
+def _esc_slots(p3: int) -> int:
+    """Escape-lane capacity of the packed record layout.  Sized so that a
+    container would need one >64 KiB-entry-units position gap (or one
+    >=511-unit match length) every 64 records to overflow — real corpora
+    measure orders of magnitude below; overflow is detected exactly and
+    falls back to a full-layout rescan."""
+    return p3 // 64 + 64
+
+
+def _packed_len(p3: int) -> int:
+    """i32 words in a packed record row: [total, nv, esc1, esc2] header +
+    A u32 per slot + one dpos low byte per slot (4 packed per word) + the
+    two escape lanes."""
+    return 4 + p3 + p3 // 4 + 2 * _esc_slots(p3)
+
+
 @functools.cache
 def _pos2_row(s4: int) -> np.ndarray:
     """Entry index -> pos/2 map for stride 2: [0,2,4,..., 1,3,5,...]."""
@@ -197,14 +226,36 @@ def _pos2_row(s4: int) -> np.ndarray:
 
 
 def _match_scan_impl(block: jax.Array, stride: int, min_len: int,
-                     p1: int, p2: int, p3: int):
-    """u8[N] (N % _S == 0) -> packed i32[1 + 2*p3] match records.
+                     p1: int, p2: int, p3: int, packed: bool = True):
+    """u8[N] (N % _S == 0) -> i32 match-record row.
 
-    Layout: [total_kept, gpos x p3, (delta<<16|len) x p3]; unused slots
-    carry gpos == _INVALID.  total_kept > valid slots means records were
-    dropped by the p1/p2/p3 slices (caller may retry wider; a dropped
-    record only costs ratio, never correctness).
+    ``packed=False`` (the full layout, also the escape-overflow rescan
+    shape): i32[1 + 2*p3] of [total_kept, gpos x p3, (delta<<16|len) x p3];
+    unused slots carry gpos == _INVALID; valid slots are position-ascending
+    (the L3 pack sorts by gpos).
+
+    ``packed=True``: i32[_packed_len(p3)] of [total_kept, n_valid,
+    esc1_cnt, esc2_cnt] + A u32 x p3 + B u32 x p3/4 + E1 x esc_slots +
+    E2 x esc_slots, where for record i (positions/deltas in entry units,
+    i.e. divided by ``stride``):
+
+      A[i] = delta_u (15 bits) | len9 (9 bits) << 15 | dpos_hi8 << 24
+      B[i // 4] byte (i % 4)   = dpos_lo8
+      dpos16 = pos_u[i] - pos_u[i-1]  (pos_u[-1] == 0); 0xFFFF escapes to
+               E1 (absolute pos_u, record order)
+      len9   = (mlen - 4) / stride, 32766 when mlen was clipped to 65535;
+               >= 511 escapes to E2 (record order), stored as 511
+
+    ~5 B/record against the full layout's 8, a ~36% smaller D2H row at the
+    default p3.  The encoding is lossless for every represented record, so
+    the host-reconstructed (pos, delta, len) triples — and therefore the
+    emitted LZ4 stream — are byte-identical to the full layout's.
+
+    In both layouts total_kept > valid slots means records were dropped by
+    the p1/p2/p3 slices (caller may retry wider; a dropped record only
+    costs ratio, never correctness).
     """
+    from hdrf_tpu.ops import sort_pallas
     from hdrf_tpu.ops.resident import be_word_image
 
     n = block.shape[0]
@@ -225,40 +276,15 @@ def _match_scan_impl(block: jax.Array, stride: int, min_len: int,
     else:
         raise ValueError("stride must be 2 or 4")
 
-    h = (vals * _HASH_MUL) >> jnp.uint32(32 - 16)
-    key = (h << jnp.uint32(pos_bits)) | posn
-
-    # Sort 1: group by hash, position-ascending within a group.  The left
-    # neighbor of an entry in sorted order with an equal hash is the nearest
-    # previous occurrence; the payload carries the 4-gram itself so equality
-    # is verified exactly on device.  (Without it, ~half the entries in a
-    # 2^16-hash row have a same-bucket predecessor by chance and
-    # incompressible data floods false records.)
-    sk, sv = jax.lax.sort((key, vals), dimension=1, num_keys=1)
-    pk = jnp.concatenate([jnp.full((t, 1), 0xFFFFFFFF, jnp.uint32),
-                          sk[:, :-1]], axis=1)
-    pv = jnp.concatenate([jnp.zeros((t, 1), jnp.uint32), sv[:, :-1]], axis=1)
-    same = (sk >> jnp.uint32(pos_bits)) == (pk >> jnp.uint32(pos_bits))
-    # Degenerate grams (all four bytes equal — RLE interiors) are excluded:
-    # their nearest occurrence is always the trivial stride-distance
-    # reference, which both floods the record extraction on runs AND
-    # shadows the long STRUCTURAL match (periodic data like TeraGen rows
-    # matches at the row period, but every filler-run gram's nearest
-    # occurrence is delta=stride, so the period is never surfaced).  The
-    # host emit recovers RLE exactly with its constant-offset probes.
-    nondegen = sv != ((sv << jnp.uint32(8)) | (sv >> jnp.uint32(24)))
-    okm = same & (sv == pv) & nondegen
-    pmask = jnp.uint32((1 << pos_bits) - 1)
-    delta = jnp.where(okm, ((sk & pmask) - (pk & pmask)) * jnp.uint32(stride),
-                      jnp.uint32(0))
-    # Nearest predecessor beyond the LZ4 offset limit -> no usable match
-    # (any farther occurrence is farther still).
-    delta = jnp.where(delta <= jnp.uint32(65535), delta, jnp.uint32(0))
-
-    # Sort 2: un-permute to position order (pos keys are unique per row), so
-    # entry i of a row is byte position stride*i and same-delta runs are
-    # neighbor relations.
-    _, d = jax.lax.sort((sk & pmask, delta), dimension=1, num_keys=1)
+    # Sorts 1+2 and the neighbor compare between them: the hash-group sort
+    # (the left neighbor of an entry in sorted order with an equal hash is
+    # the nearest previous occurrence), the exact-equality/degenerate-gram/
+    # offset-cap match rules, and the un-permute sort back to position
+    # order, so entry i of a row is byte position stride*i and same-delta
+    # runs are neighbor relations.  On TPU this is ONE Pallas kernel
+    # (bitonic networks + fused compare, see ops/sort_pallas); off-TPU the
+    # original lax.sort pipeline (match_deltas_xla) runs, bit-identically.
+    d = sort_pallas.match_deltas(vals, posn, stride, pos_bits)
 
     okp = d > 0
     pd = jnp.concatenate([jnp.zeros((t, 1), jnp.uint32), d[:, :-1]], axis=1)
@@ -307,42 +333,96 @@ def _match_scan_impl(block: jax.Array, stride: int, min_len: int,
     l_iota = jnp.broadcast_to(jnp.arange(_E3, dtype=jnp.int32), (t3, _E3))
     k3 = jnp.where(keep.reshape(t3, _E3), l_iota, jnp.int32(_E3))
     g3 = jnp.where(keep.reshape(t3, _E3), gpos.reshape(t3, _E3), _INVALID)
-    _, g1, r1 = jax.lax.sort((k3, g3, rec.reshape(t3, _E3)),
-                             dimension=1, num_keys=1)
+    _, g1, r1 = sort_pallas.sort_rows(k3, g3, rec.reshape(t3, _E3))
     g1, r1 = g1[:, :p1], r1[:, :p1]                      # L1 prefix slice
     e2 = p1 * t3 // _L2R
     g2 = g1.T.reshape(_L2R, e2)
     r2 = r1.T.reshape(_L2R, e2)
     i2 = jnp.broadcast_to(jnp.arange(e2, dtype=jnp.int32), (_L2R, e2))
     k2 = jnp.where(g2 != _INVALID, i2, jnp.int32(e2))
-    _, go, ro = jax.lax.sort((k2, g2, r2), dimension=1, num_keys=1)
+    _, go, ro = sort_pallas.sort_rows(k2, g2, r2, pad_key=_INVALID,
+                                      pad_vals=(_INVALID, np.int32(0)))
     go, ro = go[:, :p2], ro[:, :p2]                      # L2 prefix slice
     # L3 global pack: flatten and compact across rows so the D2H slice is
     # sized by the ACTUAL record count (p3), not by the per-row worst case
     # (_L2R * p2) — the padded readback measured 2-8 MB/container on this
     # corpus against ~1.5 MB of true records, and each extra D2H megabyte
-    # costs real wall time on latency-bound transports.
+    # costs real wall time on latency-bound transports.  Keyed on gpos
+    # itself (valid positions are globally unique; _INVALID is the i32 max
+    # so dead slots sort last on their own), which both drops a carried
+    # value from the sort and lands records position-ascending — the order
+    # the emit needs and the delta encoding below requires.
     gf, rf = go.reshape(-1), ro.reshape(-1)
-    i3 = jnp.arange(gf.shape[0], dtype=jnp.int32)
-    k3f = jnp.where(gf != _INVALID, i3, jnp.int32(gf.shape[0]))
-    _, g4, r4 = jax.lax.sort((k3f, gf, rf), dimension=0, num_keys=1)
-    g4, r4 = g4[:p3], r4[:p3]                            # L3 prefix slice
-    return jnp.concatenate([total[None], g4, r4])
+    g4, r4 = sort_pallas.sort_rows(gf[None], rf[None], pad_key=_INVALID,
+                                   pad_vals=(np.int32(0),))
+    g4, r4 = g4[0, :p3], r4[0, :p3]                      # L3 prefix slice
+    if not packed:
+        return jnp.concatenate([total[None], g4, r4])
+
+    # Packed readback encode (layout in the docstring).  All record fields
+    # are stride multiples, so positions/deltas/lengths pack in entry units.
+    valid = g4 != _INVALID
+    nv = jnp.sum(valid.astype(jnp.int32))
+    pos_u = jnp.where(valid, g4, 0) // stride
+    prev = jnp.concatenate([jnp.zeros(1, jnp.int32), pos_u[:-1]])
+    dpos = jnp.where(valid, pos_u - prev, 0)   # >= 0: ascending valid prefix
+    esc1 = valid & (dpos >= 0xFFFF)
+    dpos16 = jnp.where(esc1, 0xFFFF, dpos).astype(jnp.uint32)
+    ru = jax.lax.bitcast_convert_type(r4, jnp.uint32)
+    delta_u = (ru >> jnp.uint32(16)) // jnp.uint32(stride)
+    mlen = ru & jnp.uint32(0xFFFF)
+    # 65535 is the clip value, never a natural length (natural lengths are
+    # == 4 mod stride), so the sentinel is unambiguous and reversible.
+    len_u = jnp.where(mlen == jnp.uint32(65535), jnp.uint32(32766),
+                      (mlen - jnp.uint32(4)) // jnp.uint32(stride))
+    esc2 = valid & (len_u >= jnp.uint32(511))
+    l9 = jnp.where(esc2, jnp.uint32(511), len_u)
+    a_w = jnp.where(valid,
+                    delta_u | (l9 << jnp.uint32(15))
+                    | ((dpos16 >> jnp.uint32(8)) << jnp.uint32(24)),
+                    jnp.uint32(0))
+    blo = jnp.where(valid, dpos16 & jnp.uint32(0xFF), jnp.uint32(0))
+    b4 = blo.reshape(-1, 4)
+    b_w = (b4[:, 0] | (b4[:, 1] << jnp.uint32(8))
+           | (b4[:, 2] << jnp.uint32(16)) | (b4[:, 3] << jnp.uint32(24)))
+    # Escape lanes: pack-sort escaped records' absolute values to a static
+    # prefix, in record order (the key is the record slot index).
+    es = _esc_slots(p3)
+    i4 = jnp.arange(p3, dtype=jnp.int32)
+    k_e1 = jnp.where(esc1, i4, jnp.int32(p3))
+    k_e2 = jnp.where(esc2, i4, jnp.int32(p3))
+    v_e1 = jnp.where(esc1, pos_u, 0)
+    v_e2 = jnp.where(esc2, len_u.astype(jnp.int32), 0)
+    _, e1v = sort_pallas.sort_rows(k_e1[None], v_e1[None], pad_key=_INVALID,
+                                   pad_vals=(np.int32(0),))
+    _, e2v = sort_pallas.sort_rows(k_e2[None], v_e2[None], pad_key=_INVALID,
+                                   pad_vals=(np.int32(0),))
+    hdr = jnp.stack([total, nv,
+                     jnp.sum(esc1.astype(jnp.int32)),
+                     jnp.sum(esc2.astype(jnp.int32))])
+    return jnp.concatenate([
+        hdr,
+        jax.lax.bitcast_convert_type(a_w, jnp.int32),
+        jax.lax.bitcast_convert_type(b_w, jnp.int32),
+        e1v[0, :es], e2v[0, :es],
+    ])
 
 
 _match_scan = functools.partial(
-    jax.jit, static_argnames=("stride", "min_len", "p1", "p2", "p3"))(
+    jax.jit,
+    static_argnames=("stride", "min_len", "p1", "p2", "p3", "packed"))(
         _match_scan_impl)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("stride", "min_len", "p1", "p2", "p3"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("stride", "min_len", "p1", "p2", "p3", "packed"))
 def _match_scan_batch(blocks: jax.Array, stride: int, min_len: int,
-                      p1: int, p2: int, p3: int):
+                      p1: int, p2: int, p3: int, packed: bool = True):
     """K equal-length blocks in ONE device program (one dispatch, one packed
     readback for the group) — same batching rationale as _prep_batch."""
     return jnp.stack([_match_scan_impl(blocks[k], stride, min_len, p1, p2,
-                                       p3)
+                                       p3, packed)
                       for k in range(blocks.shape[0])])
 
 
@@ -432,19 +512,47 @@ class TpuLz4:
         return Lz4Job(n=a.size, host=a, block=block, recs=recs, p1=p1, p2=p2,
                       p3=p3)
 
-    def _unpack(self, rec_row: np.ndarray, p3: int):
+    def _unpack_full(self, rec_row: np.ndarray, p3: int):
         total = int(rec_row[0])
         g = rec_row[1:1 + p3]
         r = rec_row[1 + p3:]
         m = g != _INVALID
         g, r = g[m], r[m]
+        # The L3 pack sorts by gpos, so records already arrive ascending;
+        # the stable argsort is then the identity and stays as a guard only
+        # on this rare path (escape-overflow rescans).
         order = np.argsort(g, kind="stable")
         return total, g[order], r[order].view(np.uint32)
+
+    def _unpack_packed(self, rec_row: np.ndarray, p3: int):
+        from hdrf_tpu import native
+
+        total, nv = int(rec_row[0]), int(rec_row[1])
+        e1, e2 = int(rec_row[2]), int(rec_row[3])
+        es = _esc_slots(p3)
+        g, r, nrec = native.lz4_unpack_records(
+            np.ascontiguousarray(rec_row[4:]).view(np.uint32), p3, nv,
+            self.stride, es)
+        complete = e1 <= es and e2 <= es and nrec == nv
+        return total, g[:nrec], r[:nrec], complete
+
+    def _records(self, job: Lz4Job, rec_row: np.ndarray):
+        """Decode one packed record row; escape-lane overflow (needs
+        thousands of >64Ki-entry gaps or >=511-unit lengths in ONE
+        container) rescans in the full layout for the exact record set."""
+        total, g, r, complete = self._unpack_packed(rec_row, job.p3)
+        if not complete and job.block is not None:
+            _M_FLOOD.incr("escape_rescans")
+            row = np.asarray(_match_scan(job.block, self.stride,
+                                         self.min_len, job.p1, job.p2,
+                                         job.p3, packed=False))
+            return self._unpack_full(row, job.p3)
+        return total, g, r
 
     def _assemble(self, job: Lz4Job, rec_row: np.ndarray) -> bytes:
         from hdrf_tpu import native
 
-        total, g, r = self._unpack(rec_row, job.p3)
+        total, g, r = self._records(job, rec_row)
         # Slice overflow dropped records: jump every hint straight to the
         # size ``total`` demands (sticky — peers and later jobs reuse it),
         # then rescan ONCE per hint level; each full rescan costs a
@@ -484,7 +592,7 @@ class TpuLz4:
             rec_row = np.asarray(_match_scan(
                 job.block, self.stride, self.min_len, p1, p2, p3))
             job.p1, job.p2, job.p3 = p1, p2, p3
-            total, g, r = self._unpack(rec_row, p3)
+            total, g, r = self._records(job, rec_row)
         if total > g.size:
             # Record flood the slices can't represent: short-match-dense
             # data (e.g. word-soup text needs a sequence every ~9 bytes) is

@@ -134,6 +134,7 @@ class DataNode:
         # the in-process TPU path, else the host codec default.
         self._worker = None
         seal_fn = None
+        seal_batch_fn = None
         if red.worker_addr:
             from hdrf_tpu.server.reduction_worker import (WorkerClient,
                                                           WorkerError)
@@ -149,11 +150,24 @@ class DataNode:
 
                     return codecs.compress("lz4", data)
 
+            def _worker_seal_batch(datas: list) -> list:
+                try:
+                    return self._worker.compress_batch("lz4", datas)
+                except WorkerError:
+                    _M.incr("worker_fallbacks")
+                    from hdrf_tpu.utils import codec as codecs
+
+                    return [codecs.compress("lz4", d) for d in datas]
+
             if red.container_codec == "lz4":
                 seal_fn = _worker_seal
+                seal_batch_fn = _worker_seal_batch
         elif backend == "tpu" and red.container_codec == "lz4":
             seal_fn = (lambda data:
                        ops_dispatch.block_compress("lz4", data, "tpu"))
+            seal_batch_fn = (lambda datas:
+                             ops_dispatch.block_compress_batch(
+                                 "lz4", datas, "tpu"))
         # Volumes (FsVolumeList analog): one ReplicaStore + ContainerStore
         # per configured volume type, replica/chunk placement across them,
         # per-volume failure ejection (storage/volumes.py).
@@ -166,6 +180,7 @@ class DataNode:
             container_kw=dict(container_size=red.container_size,
                               codec=red.container_codec,
                               compress_fn=seal_fn,
+                              compress_batch_fn=seal_batch_fn,
                               fsync=red.fsync_containers))
         if config.simulated_dataset:
             from hdrf_tpu.storage.simulated import SimulatedReplicaStore
@@ -195,7 +210,8 @@ class DataNode:
         from hdrf_tpu.storage.aliasmap import InMemoryAliasMap
 
         self.aliasmap = InMemoryAliasMap(
-            os.path.join(config.data_dir, "aliasmap"))
+            os.path.join(config.data_dir, "aliasmap"),
+            mount_root=config.provided_mount_root or None)
         self.dn_id = dn_id or f"dn-{uuid.uuid4().hex[:8]}"
         from hdrf_tpu.proto.rpc import normalize_addrs
 
@@ -222,7 +238,7 @@ class DataNode:
         self._sender = BlockSender(self)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        self._ibr_queue: list[tuple[int, int]] = []
+        self._ibr_queue: list[tuple[int, int, int, str | None]] = []
         self._ibr_event = threading.Event()
         # Slow-peer detection inputs (DataNodePeerMetrics analog): rolling
         # window of normalized downstream-transfer latencies per peer.
@@ -388,7 +404,8 @@ class DataNode:
             self._read_sem.release()
 
     def notify_block_received(self, block_id: int, length: int,
-                              gen_stamp: int = -1) -> None:
+                              gen_stamp: int = -1,
+                              storage_type: str | None = None) -> None:
         """Incremental block report (IBR) on finalize: queued and delivered
         by a dedicated thread so an unreachable NN can never stall the write
         pipeline's ack (HDFS IBRs are asynchronous for the same reason);
@@ -402,7 +419,7 @@ class DataNode:
         # ... and revokes outstanding short-circuit grants for the same
         # reason (a cached client fd still maps the superseded inode)
         self._sc.registry.revoke(block_id)
-        self._ibr_queue.append((block_id, length, gen_stamp))
+        self._ibr_queue.append((block_id, length, gen_stamp, storage_type))
         self._ibr_event.set()
 
     def _ibr_loop(self) -> None:
@@ -410,7 +427,7 @@ class DataNode:
             self._ibr_event.wait(timeout=0.5)
             self._ibr_event.clear()
             while self._ibr_queue:
-                block_id, length, gen_stamp = self._ibr_queue.pop(0)
+                block_id, length, gen_stamp, stype = self._ibr_queue.pop(0)
                 for nn in self._nns:
                     # pool-partitioned like full reports: a foreign NS's
                     # NN would only bounce the IBR off its pool guard
@@ -420,7 +437,7 @@ class DataNode:
                     try:
                         nn.call("block_received", dn_id=self.dn_id,
                                 block_id=block_id, length=length,
-                                gen_stamp=gen_stamp)
+                                gen_stamp=gen_stamp, storage_type=stype)
                     except (OSError, ConnectionError):
                         _M.incr("ibr_failures")
 
@@ -489,9 +506,17 @@ class DataNode:
                 tokens = fields.get("tokens") or [None] * len(regions)
                 for reg, tok in zip(regions, tokens):
                     self.tokens.verify(tok, reg.block_id, "w")
+                try:
+                    for reg in regions:
+                        self.aliasmap.check_uri(reg.uri)
+                except IOError as e:
+                    _M.incr("alias_rejects")
+                    send_frame(sock, {"ok": False, "error": str(e)})
+                    return
                 self.aliasmap.write(regions)
                 for reg in regions:
-                    self.notify_block_received(reg.block_id, reg.length, 0)
+                    self.notify_block_received(reg.block_id, reg.length, 0,
+                                               storage_type="PROVIDED")
                 send_frame(sock, {"ok": True, "count": len(regions)})
             elif op == "reconfigure":
                 send_frame(sock, self.reconfigure(fields.get("key", ""),

@@ -251,7 +251,14 @@ class HttpGateway:
                 elif method == "GET" and op == "GETSNAPSHOTDIFF":
                     # oldsnapshotname is REQUIRED (an omitted/typo'd param
                     # must not silently diff the current tree against
-                    # itself and report "nothing changed")
+                    # itself and report "nothing changed") — and its
+                    # absence is the CALLER's error: a 400 with the
+                    # parameter named, not a KeyError-shaped 500.
+                    if "oldsnapshotname" not in q:
+                        return self._json(400, {
+                            "error": "IllegalArgumentException",
+                            "message": "GETSNAPSHOTDIFF requires the "
+                                       "oldsnapshotname parameter"})
                     rep = c.snapshot_diff(
                         path, q["oldsnapshotname"],
                         q.get("snapshotname", ""))

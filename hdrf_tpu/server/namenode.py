@@ -2525,7 +2525,8 @@ class NameNode:
             return True
 
     def rpc_block_received(self, dn_id: str, block_id: int, length: int,
-                           gen_stamp: int = -1) -> bool:
+                           gen_stamp: int = -1,
+                           storage_type: str | None = None) -> bool:
         """Incremental block report on pipeline finalize (IBR analog).
 
         An IBR records the replica but never fixes a UC block's length:
@@ -2545,7 +2546,7 @@ class NameNode:
                     # IBR raced ahead of the journal tail: queue it (the
                     # reference's PendingDataNodeMessages on the standby)
                     self._pending_ibr.setdefault(block_id, []).append(
-                        (dn_id, length, gen_stamp))
+                        (dn_id, length, gen_stamp, storage_type))
                     if len(self._pending_ibr) > 100_000:
                         self._pending_ibr.pop(next(iter(self._pending_ibr)))
                 return False
@@ -2556,6 +2557,12 @@ class NameNode:
                 info.reported[dn_id] = (gen_stamp, length)
                 return False
             dn.blocks.add(block_id)
+            if storage_type is not None:
+                # PROVIDED arrives here too (alias_add IBRs), so the
+                # replication monitor's shared-storage accounting never
+                # sees a provided replica as a local disk copy in the
+                # window before the next full block report.
+                info.storage_of[dn_id] = storage_type
             info.reported[dn_id] = (
                 gen_stamp if gen_stamp >= 0 else info.gen_stamp, length)
             if 0 <= length < info.length:
@@ -2590,7 +2597,7 @@ class NameNode:
     def _drain_pending_ibr(self) -> None:
         """Apply queued IBRs whose blocks the journal tail has now created."""
         for bid in [b for b in self._pending_ibr if b in self._blocks]:
-            for dn_id, length, gen_stamp in self._pending_ibr.pop(bid):
+            for dn_id, length, gen_stamp, stype in self._pending_ibr.pop(bid):
                 info = self._blocks[bid]
                 dn = self._datanodes.get(dn_id)
                 if dn is not None:
@@ -2599,6 +2606,8 @@ class NameNode:
                         length)
                     if not (0 <= gen_stamp < info.gen_stamp):
                         dn.blocks.add(bid)
+                        if stype is not None:
+                            info.storage_of[dn_id] = stype
                         # same short-replica guard as rpc_block_received:
                         # the tailed batch may have completed the block
                         if not 0 <= length < info.length:
@@ -3333,15 +3342,27 @@ class NameNode:
                 # holds live bytes, so the drain is a plain 1-replica copy.
                 want = 1 if info.block_id in ec_bids else node.replication
                 live = {d for d in info.locations if d in self._datanodes}
-                counted = live - self._decommissioning
-                deficit = want - len(counted)
-                if deficit > 0 and live:
+                # PROVIDED replicas are views of ONE shared external store:
+                # N DataNodes mounting the same provided volume add no
+                # redundancy beyond the store itself.  They count once
+                # toward the target, are never pruned as "excess" (pruning
+                # would collapse a multi-DN provided mount to a single DN),
+                # and never trigger or source deficit re-replication onto
+                # local disks (provided->local migration is an explicit
+                # operator action, not the monitor's).
+                provided = {d for d in live
+                            if info.storage_of.get(d) == "PROVIDED"}
+                local = live - provided
+                counted = local - self._decommissioning
+                deficit = want - len(counted) - (1 if provided else 0)
+                if deficit > 0 and local:
                     under += 1
-                if deficit <= 0 or not live:
+                if deficit <= 0 or not local:
                     self._pending_repl.pop(info.block_id, None)
                     if (deficit < 0
                             and info.block_id not in self._pending_moves):
-                        self._prune_excess(info, counted, want)
+                        self._prune_excess(info, counted,
+                                           want - (1 if provided else 0))
                     continue
                 # PendingReconstructionBlocks analog: don't re-queue the same
                 # block every monitor tick while a transfer is in flight.
@@ -3350,7 +3371,7 @@ class NameNode:
                     continue
                 targets = self._choose_targets(deficit, exclude=live)
                 if targets:
-                    src = self._datanodes[next(iter(live))]
+                    src = self._datanodes[next(iter(local))]
                     src.commands.append({
                         "cmd": "replicate", "block_id": info.block_id,
                         "gen_stamp": info.gen_stamp,

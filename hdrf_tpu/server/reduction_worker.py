@@ -104,6 +104,8 @@ class ReductionWorker:
                 self._op_reduce(sock, req)
             elif op == "compress":
                 self._op_compress(sock, req)
+            elif op == "compress_batch":
+                self._op_compress_batch(sock, req)
             elif op == "ping":
                 send_frame(sock, {"ok": True, "backend": self.backend})
             elif op == "stats":
@@ -193,6 +195,33 @@ class ReductionWorker:
             self._stats["compress_jobs"] += 1
         send_frame(sock, {"data": bytes(out)})
         _M.incr("compress_jobs")
+
+    def _op_compress_batch(self, sock: socket.socket, req: dict) -> None:
+        """N payloads in one round trip (a DN sealing several container
+        lanes at once): req["sizes"] splits the single concatenated packet
+        stream.  On the TPU backend equal-size payloads compress as ONE
+        device program with one grouped readback (block_compress_batch) —
+        without this op each lane pays its own dispatch + readback round
+        trip through the transport."""
+        from hdrf_tpu.ops import dispatch as ops_dispatch
+
+        sizes = [int(v) for v in req.get("sizes", [])]
+        blob = dt.collect_packets(sock)
+        if sum(sizes) != len(blob):
+            send_frame(sock, {"error": "ValueError",
+                              "message": f"sizes sum {sum(sizes)} != "
+                                         f"stream length {len(blob)}"})
+            return
+        datas, off = [], 0
+        for n in sizes:
+            datas.append(blob[off:off + n])
+            off += n
+        outs = ops_dispatch.block_compress_batch(
+            req.get("codec", "lz4"), datas, self.backend)
+        with self._stats_lock:
+            self._stats["compress_jobs"] += len(sizes)
+        send_frame(sock, {"datas": [bytes(o) for o in outs]})
+        _M.incr("compress_jobs", len(sizes))
 
 
 # ------------------------------------------------------------------ client
@@ -299,6 +328,30 @@ class WorkerClient:
                 raise WorkerError(f"worker failed: {e}") from e
             self._release(s)
             return out
+        except BaseException:
+            s.close()
+            raise
+
+    def compress_batch(self, codec: str, datas: list) -> list:
+        """Batched compress: one round trip, one worker-side device program
+        for the group (see ReductionWorker._op_compress_batch)."""
+        s = self._conn()
+        try:
+            try:
+                send_frame(s, {"op": "compress_batch", "codec": codec,
+                               "sizes": [len(d) for d in datas]})
+                seq = 0
+                for d in datas:
+                    if d:
+                        dt.write_packet(s, seq, d)
+                        seq += 1
+                dt.write_packet(s, seq, b"", last=True)
+                outs = [bytes(v)
+                        for v in self._checked(recv_frame(s))["datas"]]
+            except (OSError, ConnectionError) as e:
+                raise WorkerError(f"worker failed: {e}") from e
+            self._release(s)
+            return outs
         except BaseException:
             s.close()
             raise

@@ -54,8 +54,15 @@ class InMemoryAliasMap:
     (InMemoryAliasMap.java's LevelDB role; the write/list/read protocol
     surface of InMemoryAliasMapProtocol)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, mount_root: str | None = "/"):
+        """``mount_root`` confines every ``file://`` region to one
+        directory subtree (symlinks resolved): block tokens gate WHO may
+        alias blocks, the mount root bounds WHAT they can alias — without
+        it a write-token holder aliases a block to any DN-readable local
+        file and discloses it through the ordinary read path.  "/" opts
+        out of confinement; None/"" disables file:// resolution."""
         self._path = path
+        self._mount_root = os.path.realpath(mount_root) if mount_root else None
         self._lock = threading.Lock()
         self._map: dict[int, FileRegion] = {}
         if os.path.exists(path):
@@ -95,11 +102,28 @@ class InMemoryAliasMap:
 
     # ------------------------------------------------------------ data path
 
-    @staticmethod
-    def _open_uri(uri: str):
-        if uri.startswith("file://"):
-            return open(uri[len("file://"):], "rb")
-        raise IOError(f"unsupported provided-storage scheme: {uri}")
+    def check_uri(self, uri: str) -> None:
+        """Raise if ``uri`` is not resolvable inside the mount root.
+        Called at alias_add time (reject the region before it persists)
+        and again at every read (the file may have become a symlink out
+        of the tree since)."""
+        if not uri.startswith("file://"):
+            raise IOError(f"unsupported provided-storage scheme: {uri}")
+        if self._mount_root is None:
+            _M.incr("mount_root_rejects")
+            raise IOError("provided storage disabled: no mount root "
+                          "configured (datanode.provided_mount_root)")
+        if self._mount_root == os.sep:
+            return
+        rp = os.path.realpath(uri[len("file://"):])
+        if rp != self._mount_root and not rp.startswith(
+                self._mount_root + os.sep):
+            _M.incr("mount_root_rejects")
+            raise IOError(f"provided uri outside mount root: {uri}")
+
+    def _open_uri(self, uri: str):
+        self.check_uri(uri)
+        return open(uri[len("file://"):], "rb")
 
     def read_bytes(self, block_id: int, offset: int = 0,
                    length: int = -1) -> bytes | None:

@@ -22,6 +22,7 @@ Differences by design:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import struct
 import threading
@@ -56,10 +57,14 @@ class ContainerStore:
     def __init__(self, directory: str, container_size: int = 1 << 25,
                  lanes: int = 4, codec: str = "lz4", cache_containers: int = 4,
                  compress_fn=None, on_roll=None, fsync: bool = False,
-                 id_base: int = 0):
+                 id_base: int = 0, compress_batch_fn=None):
         """``compress_fn`` overrides the seal-time compressor while keeping
         the frame codec id (the TPU LZ4 stage produces format-identical
         output, so readers decode with the stock codec either way).
+        ``compress_batch_fn(list[bytes]) -> list[bytes]`` is its grouped
+        form: when set, ``flush_open`` seals all open lanes through ONE
+        call (one device program + one grouped readback on the TPU
+        backend) instead of a compressor round trip per lane.
         ``on_roll(cid, payload)`` observes each container's full
         uncompressed payload at seal time (from the open-lane memory
         mirror) — the hook an async seal pipeline hangs off, sparing a disk
@@ -69,6 +74,7 @@ class ContainerStore:
         self._container_size = container_size
         self._codec = codec
         self._compress_fn = compress_fn
+        self._compress_batch_fn = compress_batch_fn
         self._on_roll = on_roll
         # fsync policy for container DATA (HDFS parity: block data is not
         # fsync'd on finalize — replication is the durability story; see
@@ -244,7 +250,7 @@ class ContainerStore:
         # ~35% of ingest host cost for codec "none").
         lane.fh.write(_SEAL_HDR.pack(_RAW_MAGIC, 0, 0))
 
-    def _seal_locked(self, lane: _Lane, on_seal) -> None:
+    def _seal_locked(self, lane: _Lane, on_seal, comp=None) -> None:
         had_raw = lane.fh is not None
         if had_raw:
             lane.fh.close()
@@ -253,20 +259,23 @@ class ContainerStore:
         payload = bytes(lane.image)
         if self._on_roll is not None:
             self._on_roll(lane.container_id, payload)
-        self.seal(lane.container_id, data=payload, have_raw=had_raw)
+        self.seal(lane.container_id, data=payload, have_raw=had_raw,
+                  comp=comp)
         if on_seal is not None:
             on_seal(lane.container_id)
         lane.fh = None
         lane.image = None
 
     def seal(self, cid: int, data: bytes | None = None,
-             have_raw: bool | None = None) -> None:
+             have_raw: bool | None = None, comp: bytes | None = None) -> None:
         """Compress a raw container into the sealed format (the rollover LZ4
         pass, DataDeduplicator.java:770-781).  ``data`` carries the
         container's chunk bytes when the caller already holds them (the
         open-lane mirror); otherwise they are read from the raw file.
         ``have_raw=False`` (memory-resident lane) writes the sealed file
-        directly — there is no raw file to stamp or remove."""
+        directly — there is no raw file to stamp or remove.  ``comp`` is
+        the already-compressed payload when the caller ran the compressor
+        itself (the grouped flush_open seal)."""
         raw = self._raw_path(cid)
         if have_raw is None:
             have_raw = os.path.exists(raw)
@@ -278,7 +287,8 @@ class ContainerStore:
                 if data is None:
                     data = f.read()
                 fault_injection.point("container.seal")
-                comp = self._compress(data)
+                if comp is None:
+                    comp = self._compress(data)
                 if len(comp) >= len(data):
                     # Incompressible or codec "none": stamp the placeholder
                     # header in place and rename — no data copy.  The fsync
@@ -296,7 +306,8 @@ class ContainerStore:
         else:
             assert data is not None, "memory-resident seal needs the payload"
             fault_injection.point("container.seal")
-            comp = self._compress(data)
+            if comp is None:
+                comp = self._compress(data)
         codec = self._codec if len(comp) < len(data) else "none"
         out = comp if len(comp) < len(data) else data
         tmp = self._sealed_path(cid) + ".tmp"
@@ -320,17 +331,32 @@ class ContainerStore:
         return codecs.compress(self._codec, data)
 
     def flush_open(self, on_seal=None) -> None:
-        """Seal every open lane (shutdown/test hook)."""
-        for lane in self._lanes:
-            with lane.lock:
+        """Seal every open lane (shutdown/test hook).
+
+        With ``compress_batch_fn`` set, every sealable lane's payload is
+        compressed through ONE batched call before sealing — on the TPU
+        backend that is a single device program plus one grouped record
+        readback instead of a dispatch/readback round trip per lane."""
+        with contextlib.ExitStack() as stack:
+            sealable = []
+            for lane in self._lanes:
+                stack.enter_context(lane.lock)
                 if lane.image is not None and lane.size > 0:
-                    self._seal_locked(lane, on_seal)
+                    sealable.append(lane)
                 elif lane.image is not None:
                     if lane.fh is not None:
                         lane.fh.close()
                         os.unlink(self._raw_path(lane.container_id))
                         lane.fh = None
                     lane.image = None
+            comps = None
+            if (self._compress_batch_fn is not None and len(sealable) > 1
+                    and self._codec != "none"):
+                comps = self._compress_batch_fn(
+                    [bytes(l.image) for l in sealable])
+                _M.incr("batch_seals", len(sealable))
+            for lane, comp in zip(sealable, comps or [None] * len(sealable)):
+                self._seal_locked(lane, on_seal, comp=comp)
 
     # -------------------------------------------------------------- reading
 

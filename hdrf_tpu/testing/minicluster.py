@@ -156,7 +156,10 @@ class MiniCluster:
         cfg = DataNodeConfig(
             port=0, data_dir=os.path.join(self.base_dir, f"dn{i}"),
             heartbeat_interval_s=self._heartbeat_s,
-            block_report_interval_s=5.0)
+            block_report_interval_s=5.0,
+            # tests alias tmp-dir files from anywhere; production keeps the
+            # secure default (no mount root = file:// aliasing disabled)
+            provided_mount_root="/")
         cfg.reduction.container_size = self._dn_kw["container_size"]
         cfg.reduction.backend = "native"  # deterministic in tests
         if self._worker_addr is not None:

@@ -31,6 +31,9 @@ _WRONG_ENV = (os.environ.get("HDRF_TEST_TPU") != "1"
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: full-size kernel runs excluded from the tier-1 "
+        "sweep (the Pallas interpreter pays ~1 min per full-width network)")
     if not _WRONG_ENV or config.option.collectonly:
         return
     # Shared recipe (also used by __graft_entry__.dryrun_multichip): drop the

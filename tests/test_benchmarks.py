@@ -36,3 +36,13 @@ def test_ec_throughput():
 def test_reduction_throughput():
     out = run(["reduction", "--mb", "4", "--backend", "native"])
     assert out[0]["chunks"] > 0
+
+
+def test_sort_harness():
+    out = run(["sort", "--tiles", "1", "--entries", "2048", "--inner", "2",
+               "--repeats", "1"])
+    ops = {o["op"] for o in out}
+    # CPU mesh: only the XLA path times; the readback ledger always prints
+    assert "match_deltas [xla]" in ops and "sort_rows [xla]" in ops
+    (ledger,) = [o for o in out if o["op"] == "record readback"]
+    assert ledger["reduction_pct"] >= 25.0  # the ISSUE acceptance bar

@@ -168,6 +168,146 @@ def test_provided_file_checksum(cluster, tmp_path):
         assert fc["crc"] == native.crc32c(data)
 
 
+class TestMountRoot:
+    """alias_add confinement: block tokens gate WHO may alias, the mount
+    root bounds WHAT — a write-token holder must not be able to alias a
+    block onto /etc/shadow and read it back through the DFS."""
+
+    def test_inside_root_accepted(self, tmp_path):
+        m = InMemoryAliasMap(str(tmp_path / "amap"),
+                             mount_root=str(tmp_path))
+        m.check_uri(f"file://{tmp_path}/sub/data.bin")
+        m.check_uri(f"file://{tmp_path}")  # the root itself
+
+    def test_outside_root_rejected(self, tmp_path):
+        m = InMemoryAliasMap(str(tmp_path / "amap"),
+                             mount_root=str(tmp_path / "mnt"))
+        with pytest.raises(IOError, match="outside mount root"):
+            m.check_uri("file:///etc/hostname")
+        with pytest.raises(IOError, match="outside mount root"):
+            # prefix trick: /mnt-evil shares the string prefix, not the tree
+            m.check_uri(f"file://{tmp_path}/mnt-evil/x")
+        with pytest.raises(IOError, match="outside mount root"):
+            m.check_uri(f"file://{tmp_path}/mnt/../escape")
+
+    def test_symlink_out_of_tree_rejected_at_read(self, tmp_path):
+        root = tmp_path / "mnt"
+        root.mkdir()
+        secret = tmp_path / "secret.bin"
+        secret.write_bytes(b"s" * 64)
+        link = root / "alias.bin"
+        link.symlink_to(secret)
+        m = InMemoryAliasMap(str(tmp_path / "amap"), mount_root=str(root))
+        m.write([FileRegion(5, f"file://{link}", 0, 64)])
+        # check_uri re-resolves at read time: the symlink escapes the tree
+        with pytest.raises(IOError, match="outside mount root"):
+            m.read_bytes(5)
+
+    def test_disabled_root_refuses_everything(self, tmp_path):
+        m = InMemoryAliasMap(str(tmp_path / "amap"), mount_root=None)
+        with pytest.raises(IOError, match="provided storage disabled"):
+            m.check_uri(f"file://{tmp_path}/x")
+
+    def test_non_file_scheme_rejected(self, tmp_path):
+        m = InMemoryAliasMap(str(tmp_path / "amap"))
+        with pytest.raises(IOError, match="unsupported"):
+            m.check_uri("s3://bucket/key")
+
+    def test_alias_add_rejects_outside_mount_root(self, tmp_path):
+        """End to end through the DN op: a region outside the configured
+        mount root is refused and never persisted or reported."""
+        from hdrf_tpu.tools.cli import _dn_call
+        with MiniCluster(n_datanodes=1, replication=1) as mc:
+            dn = mc.datanodes[0]
+            dn.aliasmap._mount_root = str(tmp_path)  # tighten from "/"
+            addr = f"{dn.addr[0]}:{dn.addr[1]}"
+            out = _dn_call(addr, "alias_add",
+                           regions=[[777, "file:///etc/hostname", 0, 10]],
+                           tokens=None)
+            assert not out.get("ok") and "outside mount root" in out["error"]
+            assert dn.aliasmap.read(777) is None
+            inside = tmp_path / "ok.bin"
+            inside.write_bytes(b"x" * 10)
+            out = _dn_call(addr, "alias_add",
+                           regions=[[778, f"file://{inside}", 0, 10]],
+                           tokens=None)
+            assert out["ok"]
+            assert dn.aliasmap.read(778) is not None
+
+
+class TestProvidedReplication:
+    """The replication monitor's shared-storage accounting: N provided
+    locations are views of ONE external store — counted once, never pruned
+    as excess, never a re-replication source."""
+
+    def _nn(self, tmp_path, replication=1):
+        from hdrf_tpu.config import NameNodeConfig
+        from hdrf_tpu.server.namenode import NameNode
+        cfg = NameNodeConfig(meta_dir=str(tmp_path / "name"),
+                             replication=replication, block_size=1024,
+                             dead_node_interval_s=60.0)
+        return NameNode(cfg)
+
+    def _provide_block(self, nn, n_dns, path="/p"):
+        for i in range(n_dns):
+            nn.rpc_register_datanode(f"dn-{i}", [f"h{i}", 1000 + i])
+        out = nn.rpc_provide_file(path, uri="file:///ext/p.bin", length=512)
+        bid = out["regions"][0][0]
+        for i in range(n_dns):
+            nn.rpc_block_received(f"dn-{i}", bid, 512,
+                                  storage_type="PROVIDED")
+        return bid
+
+    def test_provided_locations_not_pruned(self, tmp_path):
+        nn = self._nn(tmp_path, replication=1)
+        try:
+            bid = self._provide_block(nn, n_dns=3)
+            info = nn._blocks[bid]
+            assert len(info.locations) == 3
+            nn._check_replication()
+            # pre-fix behavior: 3 locations vs want=1 -> two invalidated
+            assert len(info.locations) == 3, "provided replicas pruned"
+            for i in range(3):
+                assert not nn._datanodes[f"dn-{i}"].commands
+        finally:
+            nn._editlog.close()
+
+    def test_provided_never_sources_re_replication(self, tmp_path):
+        nn = self._nn(tmp_path, replication=3)
+        try:
+            bid = self._provide_block(nn, n_dns=3)
+            nn._check_replication()
+            # one shared store != 3 replicas, but re-replication onto local
+            # disks from a provided view is an operator action, not the
+            # monitor's: no replicate commands, not counted under-replicated
+            for i in range(3):
+                assert not nn._datanodes[f"dn-{i}"].commands
+            assert bid not in nn._pending_repl
+            assert nn._under_replicated == 0
+        finally:
+            nn._editlog.close()
+
+    def test_excess_prune_targets_local_never_provided(self, tmp_path):
+        # Provided files carry replication=1; an extra LOCAL copy (an
+        # explicit provided->local migration racing the monitor) IS excess
+        # — but the victim must be the local replica, never a provided
+        # view.
+        nn = self._nn(tmp_path)
+        try:
+            bid = self._provide_block(nn, n_dns=3)
+            info = nn._blocks[bid]
+            info.storage_of["dn-2"] = "DISK"   # dn-2 now a local copy
+            nn._check_replication()
+            assert info.locations == {"dn-0", "dn-1"}  # provided survive
+            inval = [c for c in nn._datanodes["dn-2"].commands
+                     if c["cmd"] == "invalidate"]
+            assert inval and bid in inval[0]["block_ids"]
+            assert not nn._datanodes["dn-0"].commands
+            assert not nn._datanodes["dn-1"].commands
+        finally:
+            nn._editlog.close()
+
+
 def test_alias_add_requires_token_when_secure(tmp_path):
     """With block tokens on, a tokenless alias_add is refused — the DN-side
     gate matching rpc_provide_file's superuser-only NN gate."""

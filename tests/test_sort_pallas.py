@@ -1,0 +1,178 @@
+"""Pallas bitonic sort kernel: bit-identity against ``jax.lax.sort``.
+
+The kernel (ops/sort_pallas.py) replaces every ``lax.sort`` site of the LZ4
+match scan, so its contract is exact: on rows whose keys are unique (all the
+live call sites salt keys with position) the network must produce the SAME
+permutation as ``jax.lax.sort`` — not merely a sorted one.  The CPU test
+mesh cannot run Mosaic kernels, so the network itself executes through the
+Pallas interpreter (``interpret=True``), which exercises the identical
+kernel program the TPU compiles.  The interpreter pays about a minute per
+full-width network, so tier-1 runs the smallest kernel width (1024 = the
+_MIN_E floor) and the production widths ride the ``slow`` marker.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hdrf_tpu.ops import sort_pallas
+
+RNG = np.random.default_rng(7)
+
+
+def _lax_rows(key, *vals):
+    return jax.lax.sort((key, *vals), dimension=1, num_keys=1)
+
+
+def _unique_keys(t, e, dtype):
+    key = np.stack([RNG.permutation(e).astype(np.int64) for _ in range(t)])
+    if dtype == np.uint32:
+        return (key + 0xFFFF0000 - e // 2).astype(np.uint32)  # wraps sign bit
+    return (key - e // 2).astype(np.int32)                    # negatives
+
+
+def _assert_bit_identical(e, dtype):
+    t = 3
+    key = _unique_keys(t, e, dtype)
+    v1 = RNG.integers(0, 2**32, size=(t, e), dtype=np.uint32)
+    v2 = RNG.integers(-2**31, 2**31, size=(t, e)).astype(np.int32)
+    got = sort_pallas.sort_rows(jnp.asarray(key), jnp.asarray(v1),
+                                jnp.asarray(v2), impl="pallas",
+                                interpret=True)
+    want = _lax_rows(jnp.asarray(key), jnp.asarray(v1), jnp.asarray(v2))
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+class TestSortRows:
+    @pytest.mark.parametrize("dtype", [np.int32, np.uint32])
+    def test_unique_keys_bit_identical(self, dtype):
+        _assert_bit_identical(1024, dtype)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("e", [2048, 8192])
+    @pytest.mark.parametrize("dtype", [np.int32, np.uint32])
+    def test_unique_keys_bit_identical_full_width(self, e, dtype):
+        _assert_bit_identical(e, dtype)
+
+    def test_ties_stay_sorted_and_values_are_a_permutation(self):
+        # Duplicate keys: the network is unstable, so only assert key order
+        # plus KV-pair multiset preservation.
+        t, e = 2, 1024
+        key = RNG.integers(0, 16, size=(t, e), dtype=np.int32)
+        val = np.arange(t * e, dtype=np.int32).reshape(t, e)
+        sk, sv = sort_pallas.sort_rows(jnp.asarray(key), jnp.asarray(val),
+                                       impl="pallas", interpret=True)
+        sk, sv = np.asarray(sk), np.asarray(sv)
+        assert (np.diff(sk, axis=1) >= 0).all()
+        for r in range(t):
+            assert sorted(zip(sk[r], sv[r])) == sorted(zip(key[r], val[r]))
+
+    def test_non_pow2_rows_pad_to_sentinel(self):
+        t, e = 2, 1500  # pads to 2048 — the L2/L3 pack-sort shape
+        key = np.stack([RNG.permutation(e).astype(np.int32)
+                        for _ in range(t)])
+        val = RNG.integers(0, 2**31, size=(t, e), dtype=np.int32)
+        inv = np.int32(2**31 - 1)
+        sk, sv = sort_pallas.sort_rows(
+            jnp.asarray(key), jnp.asarray(val), impl="pallas",
+            interpret=True, pad_key=inv, pad_vals=(np.int32(0),))
+        sk, sv = np.asarray(sk), np.asarray(sv)
+        assert sk.shape == (t, 2048)
+        wk, wv = _lax_rows(jnp.asarray(key), jnp.asarray(val))
+        np.testing.assert_array_equal(sk[:, :e], np.asarray(wk))
+        np.testing.assert_array_equal(sv[:, :e], np.asarray(wv))
+        assert (sk[:, e:] == inv).all()
+
+    def test_xla_fallback_below_min_e(self):
+        # e < _MIN_E silently takes lax.sort even when pallas is requested.
+        key = RNG.permutation(256).astype(np.int32)[None]
+        val = np.arange(256, dtype=np.int32)[None]
+        got = sort_pallas.sort_rows(jnp.asarray(key), jnp.asarray(val),
+                                    impl="pallas", interpret=True)
+        want = _lax_rows(jnp.asarray(key), jnp.asarray(val))
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    def test_env_override_disables_pallas(self, monkeypatch):
+        monkeypatch.setenv("HDRF_SORT_PALLAS", "0")
+        assert not sort_pallas.use_pallas()
+
+    def test_cpu_backend_defaults_to_xla(self):
+        # The test mesh is XLA:CPU, so the default dispatch must not try a
+        # compiled Mosaic kernel (which CPU rejects outright).
+        assert jax.default_backend() != "tpu"
+        assert not sort_pallas.use_pallas()
+        key = np.stack([RNG.permutation(4096).astype(np.int32)])
+        val = np.zeros((1, 4096), np.int32)
+        sk, _ = sort_pallas.sort_rows(jnp.asarray(key), jnp.asarray(val))
+        assert (np.diff(np.asarray(sk), axis=1) > 0).all()
+
+
+def _gram_image(data, stride, e):
+    """4-gram little-endian words at stride-aligned positions — the same
+    image _match_scan_impl feeds the delta pipeline."""
+    rows = []
+    for r in range(data.shape[0]):
+        b = np.concatenate([data[r], np.zeros(4, np.uint8)])
+        w = (b[:-4].astype(np.uint32) | (b[1:-3].astype(np.uint32) << 8)
+             | (b[2:-2].astype(np.uint32) << 16)
+             | (b[3:-1].astype(np.uint32) << 24))
+        rows.append(w[::stride][:e])
+    return jnp.asarray(np.stack(rows))
+
+
+def _posn(t, e, stride):
+    if stride == 2:
+        idx = np.arange(e)
+        p = np.where(idx < e // 2, 2 * idx, 2 * (idx - e // 2) + 1)
+    else:
+        p = np.arange(e)
+    return jnp.asarray(p.astype(np.uint32))[None].repeat(t, axis=0)
+
+
+def _corpus(name, t, n):
+    if name == "text":
+        data = RNG.integers(97, 123, size=(t, n), dtype=np.uint8)
+        data[:, ::3] = 32
+        return data
+    if name == "zeros":
+        return np.zeros((t, n), np.uint8)
+    return RNG.integers(0, 256, size=(t, n), dtype=np.uint8)
+
+
+def _assert_deltas_match(stride, corpus, e):
+    t = 2
+    data = _corpus(corpus, t, e * stride)
+    vals = _gram_image(data, stride, e)
+    pos_bits = int(e - 1).bit_length()
+    want = sort_pallas.match_deltas_xla(vals, _posn(t, e, stride), stride,
+                                        pos_bits)
+    got = sort_pallas.match_deltas(vals, _posn(t, e, stride), stride,
+                                   pos_bits, impl="pallas", interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestMatchDeltas:
+    @pytest.mark.parametrize("stride,corpus", [(2, "text"), (4, "random")])
+    def test_fused_kernel_matches_xla_reference(self, stride, corpus):
+        _assert_deltas_match(stride, corpus, 1024)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("stride", [2, 4])
+    @pytest.mark.parametrize("corpus", ["text", "zeros", "random"])
+    def test_fused_kernel_matches_xla_reference_full_width(self, stride,
+                                                           corpus):
+        _assert_deltas_match(stride, corpus, 4096)
+
+    def test_dispatcher_falls_back_off_tpu(self):
+        e = 2048
+        vals = jnp.asarray(RNG.integers(0, 2**32, size=(1, e),
+                                        dtype=np.uint32))
+        posn = jnp.asarray(np.arange(e, dtype=np.uint32))[None]
+        got = sort_pallas.match_deltas(vals, posn, 4, 11)
+        want = sort_pallas.match_deltas_xla(vals, posn, 4, 11)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -185,6 +185,22 @@ class TestWebHdfsFileSystem:
         http.op_json("DELETE", "/snap", "DELETESNAPSHOT",
                      snapshotname="s1")
 
+    def test_snapshot_diff_missing_oldsnapshotname_is_400(self, fs):
+        """An omitted oldsnapshotname must come back as a 400 with the
+        parameter named — not a KeyError-shaped 500, and never a silent
+        self-diff reporting "nothing changed"."""
+        http, _ = fs
+        assert http.op_json("PUT", "/sd400", "MKDIRS")["boolean"]
+        http.op_json("PUT", "/sd400", "ALLOWSNAPSHOT")
+        http.op_json("PUT", "/sd400", "CREATESNAPSHOT", snapshotname="s1")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http.op_json("GET", "/sd400", "GETSNAPSHOTDIFF",
+                         snapshotname="s1")
+        assert ei.value.code == 400
+        body = json.loads(ei.value.read())
+        assert body["error"] == "IllegalArgumentException"
+        assert "oldsnapshotname" in body["message"]
+
     def test_getfilechecksum(self, fs):
         http, _ = fs
         http.write("/fck", b"checksum-me" * 1000)

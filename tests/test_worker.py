@@ -67,6 +67,20 @@ class TestWorkerProtocol:
         assert native.lz4_decompress(comp, len(data)) == data
         c.close()
 
+    def test_compress_batch_roundtrip(self, worker):
+        from hdrf_tpu import native
+
+        datas = [(b"lorem ipsum dolor " * 4000)[:60_000], _bytes(30_000),
+                 b"\x00" * 50_000]
+        c = WorkerClient(worker.addr)
+        outs = c.compress_batch("lz4", datas)
+        assert len(outs) == len(datas)
+        for d, comp in zip(datas, outs):
+            assert native.lz4_decompress(comp, len(d)) == d
+        # batch must equal the per-item op byte for byte
+        assert outs == [c.compress("lz4", d) for d in datas]
+        c.close()
+
     def test_ping_and_stats(self, worker):
         c = WorkerClient(worker.addr)
         assert c.ping()["backend"] == "native"
