@@ -56,6 +56,12 @@ E2E_BLOCKS = 8          # full-path pass size (HBM also holds container images)
 TG_BLOCKS = 8           # TeraGen-corpus pass size (long enough steady state
                         # to amortize the fixed dispatch/readback overheads)
 
+if os.environ.get("HDRF_BENCH_SMOKE") == "1":
+    # Tiny-corpus mode for the tier-1 one-line guard test: same code path
+    # and JSON contract, seconds instead of minutes (runs under XLA:CPU).
+    BLOCK_MB, N_BLOCKS, SUB_BATCHES, CPU_MB = 1, 2, 2, 1
+    E2E_BLOCKS = TG_BLOCKS = 2
+
 
 def _make_block(mb: int, seed: int) -> np.ndarray:
     """Realistic-entropy block: compressible text-like spans + binary spans +
@@ -196,7 +202,9 @@ def _cpu_full(blocks: list[np.ndarray], cdc, tmp: str, tag: str):
 def main() -> None:
     from hdrf_tpu.config import CdcConfig
     from hdrf_tpu.ops.dispatch import resolve_backend
+    from hdrf_tpu.utils import device_ledger
 
+    led0 = device_ledger.stamp()   # dispatch-ledger baseline for the run
     cdc = CdcConfig()
     base = _make_block(BLOCK_MB, seed=42)
     cpu_blocks = [_salt(base[: CPU_MB << 20], 100 + i) for i in range(2)]
@@ -222,6 +230,7 @@ def main() -> None:
                 v, rr = _cpu_full(e2e_hosts, cdc, tmp, f"cpu{i}")
                 if v > cpu_e2e:
                     cpu_e2e, cpu_ratio = v, rr
+            led = device_ledger.delta(led0)
             print(json.dumps({
                 "metric": "block reduction pipeline throughput (CDC+SHA-256), "
                           "native CPU backend (no TPU attached)",
@@ -229,6 +238,8 @@ def main() -> None:
                 "vs_baseline": 1.0,
                 "e2e_value": round(cpu_e2e, 2), "e2e_vs_baseline": 1.0,
                 "e2e_ratio_cpu": round(cpu_ratio, 3),
+                "ledger": led,
+                "stalls": led.get("stall_total", 0),
             }))
             return
 
@@ -484,12 +495,16 @@ def main() -> None:
                                                f"{label}_cpu{i}")
                         cpu_rates.append(v)
                     else:
+                        from hdrf_tpu.utils import device_ledger
+                        leg0 = device_ledger.stamp()
                         v, tpu_ratio = tpu_pass(i)
+                        leg_led = device_ledger.delta(leg0)
                         tpu_rates.append(v)
                 ratios.append(tpu_rates[-1] / cpu_rates[-1])
                 if DEBUG:
                     print(f"[{label}] round{i} cpu={cpu_rates[-1]:.1f} "
-                          f"tpu={tpu_rates[-1]:.1f} ratio={ratios[-1]:.3f}",
+                          f"tpu={tpu_rates[-1]:.1f} ratio={ratios[-1]:.3f} "
+                          f"ledger={leg_led}",
                           file=sys.stderr)
             cleanup()
             return {"tpu": statistics.median(tpu_rates),
@@ -508,6 +523,7 @@ def main() -> None:
         tg_hosts = _teragen_blocks(TG_BLOCKS, BLOCK_MB)
         tg = paired(tg_hosts, "tg", rounds=5)
 
+        led = device_ledger.delta(led0)
         print(json.dumps({
             "metric": "block reduction service rate (CDC+SHA-256), "
                       f"HBM-resident {BLOCK_MB} MiB blocks, overlapped "
@@ -529,6 +545,8 @@ def main() -> None:
             "tg_vs_baseline": round(tg["paired"], 3),
             "tg_ratio_tpu": round(tg["red_tpu"], 3),
             "tg_ratio_cpu": round(tg["red_cpu"], 3),
+            "ledger": led,
+            "stalls": led.get("stall_total", 0),
         }))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -95,8 +95,11 @@ def bench_dfs(args) -> None:
     payload = payload.tobytes()
     with MiniCluster(n_datanodes=args.datanodes, replication=args.replication,
                      block_size=8 << 20) as mc:
+        from hdrf_tpu.utils import device_ledger
+
         with mc.client("bench") as c:
             for scheme in args.schemes.split(","):
+                led0 = device_ledger.stamp()
                 t0 = time.perf_counter()
                 c.write(f"/bench/{scheme}", payload, scheme=scheme)
                 w = n / (time.perf_counter() - t0) / 2**20
@@ -104,9 +107,12 @@ def bench_dfs(args) -> None:
                 got = c.read(f"/bench/{scheme}")
                 r = n / (time.perf_counter() - t0) / 2**20
                 assert got == payload
+                led = device_ledger.delta(led0)
                 print(json.dumps({"scheme": scheme,
                                   "write_MBps": round(w, 1),
-                                  "read_MBps": round(r, 1)}))
+                                  "read_MBps": round(r, 1),
+                                  "ledger": led,
+                                  "stalls": led.get("stall_total", 0)}))
 
 
 def bench_ec(args) -> None:
@@ -156,11 +162,17 @@ def bench_reduction(args) -> None:
     cdc = CdcConfig()
     backend = dispatch.resolve_backend(args.backend)
     dispatch.chunk_and_fingerprint(data[: 1 << 20], cdc, backend)  # warm
+    from hdrf_tpu.utils import device_ledger
+
+    led0 = device_ledger.stamp()
     t0 = time.perf_counter()
     cuts, digs = dispatch.chunk_and_fingerprint(data, cdc, backend)
     mbps = n / (time.perf_counter() - t0) / 2**20
+    led = device_ledger.delta(led0)
     print(json.dumps({"op": f"reduction pipeline [{backend}]",
-                      "MBps": round(mbps, 1), "chunks": int(cuts.size)}))
+                      "MBps": round(mbps, 1), "chunks": int(cuts.size),
+                      "ledger": led,
+                      "stalls": led.get("stall_total", 0)}))
 
 
 def bench_recon(args) -> None:

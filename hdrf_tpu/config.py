@@ -149,6 +149,11 @@ class NameNodeConfig:
     # the journal's purge horizon bootstraps its fsimage from a peer
     # (the standby-checkpointer image-transfer analog).
     peers: list | None = None
+    # Observability status HTTP server (/prom, /traces, /stacks — the
+    # HttpServer2 servlet-set analog); None = disabled.  0 = ephemeral port.
+    status_port: int | None = None
+    # Watchdog budget for in-flight RPCs (utils/watchdog.py).
+    stall_budget_s: float = 30.0
 
 
 @dataclass
@@ -204,6 +209,13 @@ class DataNodeConfig:
     # Empty = provided storage disabled for file:// URIs; "/" opts out of
     # confinement explicitly.
     provided_mount_root: str = ""
+    # Observability status HTTP server (/prom, /traces, /stacks — the
+    # HttpServer2 servlet-set analog); None = disabled.  0 = ephemeral port.
+    status_port: int | None = None
+    # Watchdog budget for in-flight data-transfer ops (utils/watchdog.py):
+    # flags ops outliving this many seconds (the ~35 s VM write-burst
+    # stalls, PERF_NOTES.md).
+    stall_budget_s: float = 30.0
     reduction: ReductionConfig = field(default_factory=ReductionConfig)
 
 

@@ -20,6 +20,11 @@ import threading
 import numpy as np
 
 from hdrf_tpu.config import CdcConfig
+from hdrf_tpu.utils import metrics as _metrics
+
+# Op-level accounting at the dispatch boundary (per-dispatch device
+# accounting lives in utils/device_ledger.py, fed by the ops modules).
+_M = _metrics.registry("ops_dispatch")
 
 
 def resolve_backend(backend: str) -> str:
@@ -108,6 +113,9 @@ def chunk_and_fingerprint(data: bytes | np.ndarray, cdc: CdcConfig,
     candidate scan with ICI halo exchange + chunk-parallel SHA lanes over
     every chip.  The native path is the CPU baseline pair of calls.
     """
+    nbytes = len(data) if isinstance(data, (bytes, bytearray)) else data.nbytes
+    _M.incr(f"reduce_{backend}_total")
+    _M.incr(f"reduce_{backend}_bytes", nbytes)
     if backend == "tpu":
         mesh = _multichip_mesh()
         if mesh is not None:
@@ -136,6 +144,8 @@ def block_compress(codec: str, data: bytes, backend: str = "native") -> bytes:
     codec/backend pair uses the host codec path.  Output is format-identical
     either way (standard LZ4 block), so readers never care who compressed."""
     global _tpu_lz4
+    _M.incr(f"compress_{backend}_total")
+    _M.incr(f"compress_{backend}_bytes", len(data))
     if codec == "lz4" and backend == "tpu":
         return _lz4_device().compress(data)
     from hdrf_tpu.utils import codec as codecs
@@ -162,5 +172,7 @@ def block_compress_batch(codec: str, datas: list,
     seals, where per-container dispatch+readback round trips dominate.
     Everything else degrades to per-item block_compress."""
     if codec == "lz4" and backend == "tpu":
+        _M.incr(f"compress_{backend}_total", len(datas))
+        _M.incr(f"compress_{backend}_bytes", sum(len(d) for d in datas))
         return _lz4_device().compress_many(datas)
     return [block_compress(codec, d, backend) for d in datas]

@@ -41,6 +41,7 @@ from hdrf_tpu.config import CdcConfig
 from hdrf_tpu.ops import gear
 from hdrf_tpu.ops.dispatch import gear_mask
 from hdrf_tpu.ops.sha256 import sha256_words
+from hdrf_tpu.utils import device_ledger as _ledger
 
 
 # Block padding grid: lcm of the bitmap pack row (256 bytes) and the
@@ -218,6 +219,8 @@ class BatchJob:
     true_n: int               # unpadded byte length per block
     cuts: list[np.ndarray] | None = None
     _sha_parts: tuple | None = None
+    _ev: object = None        # ledger token: prep dispatch -> cand readback
+    _ev_sha: list | None = None  # ledger tokens: sha dispatches -> digest rb
 
 
 @dataclasses.dataclass
@@ -229,6 +232,8 @@ class BlockJob:
     cap: int
     cuts: np.ndarray | None = None
     _sha_parts: tuple | None = None  # (sels, lane_counts, digests_dev)
+    _ev: object = None        # ledger token: prep dispatch -> cand readback
+    _ev_sha: list | None = None  # ledger tokens: sha dispatches -> digest rb
 
 
 class ResidentReducer:
@@ -297,10 +302,14 @@ class ResidentReducer:
         cap = max(1, min(n // 32,
                          max(1024, (n >> max(self.cdc.mask_bits - 1, 0))
                              + 1024)))
+        ev = _ledger.dispatch(
+            "resident.prep_batch", batch=k,
+            h2d_bytes=0 if isinstance(datas, jax.Array) else k * n,
+            key=(k, n, cap))
         words, cand = _prep_batch(stacked, self.mask, cap, self.pad_words)
         cand.copy_to_host_async()
         return BatchJob(k=k, n=n, blocks=stacked, words=words, cand=cand,
-                        cap=cap, true_n=true_n)
+                        cap=cap, true_n=true_n, _ev=ev)
 
     def _cuts_from_cand(self, cand_row: np.ndarray, cap: int, block,
                         true_n: int) -> np.ndarray:
@@ -315,8 +324,11 @@ class ResidentReducer:
         count = int(cand_row[0])
         if count > cap:
             cap = count
+            ev = _ledger.dispatch("resident.prep_retry",
+                                  key=(block.shape, cap))
             _, cd = _prep(block, self.mask, cap, self.pad_words)
             cand_row = np.asarray(cd)
+            _ledger.readback(ev, d2h_bytes=cand_row.nbytes)
             count = int(cand_row[0])
         idx = cand_row[1:1 + count].astype(np.uint32)
         vals = cand_row[1 + cap:1 + cap + count].view(np.uint32)
@@ -326,6 +338,8 @@ class ResidentReducer:
 
     def start_sha_many(self, bj: BatchJob) -> None:
         cand = np.asarray(bj.cand)            # ONE readback for the group
+        _ledger.readback(bj._ev, d2h_bytes=cand.nbytes)
+        bj._ev = None
         cuts_all, starts_all, lens_all = [], [], []
         for k in range(bj.k):
             cuts = self._cuts_from_cand(cand[k], bj.cap, bj.blocks[k],
@@ -344,7 +358,7 @@ class ResidentReducer:
         lens = np.concatenate(lens_all)
         nb = (lens + 9 + 63) // 64
         flat_off = blk * stride_b + starts
-        parts, sels = [], []
+        parts, sels, evs = [], [], []
         lo = 0
         for B in self._buckets:
             m = (nb > lo) & (nb <= B)
@@ -356,6 +370,8 @@ class ResidentReducer:
             ol = np.zeros((2, L), dtype=np.int32)
             ol[0, :sel.size] = flat_off[sel]
             ol[1, :sel.size] = lens[sel]
+            evs.append(_ledger.dispatch("resident.sha", batch=sel.size,
+                                        h2d_bytes=ol.nbytes, key=(B, L)))
             parts.append(_bucket_sha_best(bj.words.reshape(-1), ol, B))
             sels.append((blk[sel], chunk_i[sel]))
         if parts:
@@ -365,6 +381,7 @@ class ResidentReducer:
         else:
             alld = None
         bj._sha_parts = (sels, [p.shape[0] for p in parts], alld)
+        bj._ev_sha = evs
         bj.blocks = None
 
     def finish_many(self, bj: BatchJob) -> list[tuple[np.ndarray, np.ndarray]]:
@@ -374,6 +391,9 @@ class ResidentReducer:
         outs = [np.empty((len(c), 32), dtype=np.uint8) for c in bj.cuts]
         if digs_dev is not None:
             digs = np.asarray(digs_dev)
+            for i, ev in enumerate(bj._ev_sha or ()):
+                _ledger.readback(ev, d2h_bytes=digs.nbytes if i == 0 else 0)
+            bj._ev_sha = None
             at = 0
             for (blks, idxs), L in zip(sels, lane_counts):
                 rows = digs[at:at + blks.size]
@@ -447,15 +467,22 @@ class ResidentReducer:
             return job
         cap = max(1, min(block.shape[0] // 32,
                          max(1024, (n >> max(self.cdc.mask_bits - 1, 0)) + 1024)))
+        ev = _ledger.dispatch(
+            "resident.prep",
+            h2d_bytes=0 if isinstance(data, jax.Array) else block.shape[0],
+            key=(block.shape, cap))
         words, cand = _prep(block, self.mask, cap, self.pad_words)
         cand.copy_to_host_async()
-        return BlockJob(n=n, block=block, words=words, cand=cand, cap=cap)
+        return BlockJob(n=n, block=block, words=words, cand=cand, cap=cap,
+                        _ev=ev)
 
     def start_sha(self, job: BlockJob) -> None:
         if job.cand is None:  # empty block prepared entirely in submit()
             return
-        cuts = self._cuts_from_cand(np.asarray(job.cand), job.cap,
-                                    job.block, job.n)
+        cand = np.asarray(job.cand)
+        _ledger.readback(job._ev, d2h_bytes=cand.nbytes)
+        job._ev = None
+        cuts = self._cuts_from_cand(cand, job.cap, job.block, job.n)
         job.cuts = cuts
         starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
         lens = (cuts - starts).astype(np.int64)
@@ -466,7 +493,7 @@ class ResidentReducer:
         # chunk-size distribution (~2x the mean), the big one the tail, and
         # padded-lane waste stays comparable to pow2 bucketing.
         order = np.arange(len(cuts))
-        sels, parts = [], []
+        sels, parts, evs = [], [], []
         for sel, B in ((order[nb <= self._b_small], self._b_small),
                        (order[nb > self._b_small], self._b_big)):
             if not sel.size:
@@ -475,6 +502,8 @@ class ResidentReducer:
             ol = np.zeros((2, L), dtype=np.int32)
             ol[0, :sel.size] = starts[sel]
             ol[1, :sel.size] = lens[sel]
+            evs.append(_ledger.dispatch("resident.sha", batch=sel.size,
+                                        h2d_bytes=ol.nbytes, key=(B, L)))
             parts.append(_bucket_sha_best(job.words, ol, B))
             sels.append(sel)
         # One device-side concat -> ONE digest readback (each extra D2H costs
@@ -485,6 +514,7 @@ class ResidentReducer:
         else:  # empty block: no chunks, no digests
             alld = None
         job._sha_parts = (sels, [p.shape[0] for p in parts], alld)
+        job._ev_sha = evs
         job.block = None  # cuts are final; release the u8 image
 
     def finish(self, job: BlockJob) -> tuple[np.ndarray, np.ndarray]:
@@ -494,6 +524,9 @@ class ResidentReducer:
         out = np.empty((len(job.cuts), 32), dtype=np.uint8)
         if digs_dev is not None:
             digs = np.asarray(digs_dev)
+            for i, ev in enumerate(job._ev_sha or ()):
+                _ledger.readback(ev, d2h_bytes=digs.nbytes if i == 0 else 0)
+            job._ev_sha = None
             at = 0
             for sel, L in zip(sels, lane_counts):
                 out[sel] = digs[at:at + sel.size]

@@ -35,6 +35,7 @@ On TPU pods where the runtime provides topology env vars,
 from __future__ import annotations
 
 import argparse
+import sys
 
 import jax
 import numpy as np
@@ -103,9 +104,13 @@ def _selftest(mb: int, n_data: int) -> bool:
     starts = np.concatenate([[0], want_cuts[:-1]]).astype(np.int64)
     want_digs = native.sha256_batch(data, starts, (want_cuts - starts))
     ok = ok and np.array_equal(digests, want_digs)
-    print(f"rank {jax.process_index()}/{jax.process_count()}: "
-          f"devices={jax.device_count()} chunks={len(cuts)} "
-          f"oracle_match={ok}", flush=True)
+    from hdrf_tpu.utils import log
+
+    log.get_logger("launch", stream=sys.stdout).info(
+        f"rank {jax.process_index()}/{jax.process_count()}: "
+        f"devices={jax.device_count()} chunks={len(cuts)} "
+        f"oracle_match={ok}",
+        rank=jax.process_index(), oracle_match=bool(ok))
     return ok
 
 
@@ -124,9 +129,12 @@ def main(argv=None) -> int:
     initialize(args.coordinator, args.nprocs, args.rank)
     if args.selftest:
         return 0 if _selftest(args.selftest, args.n_data) else 1
-    print(f"rank {jax.process_index()}/{jax.process_count()} up; "
-          f"{jax.local_device_count()} local / {jax.device_count()} "
-          f"global devices", flush=True)
+    from hdrf_tpu.utils import log
+
+    log.get_logger("launch", stream=sys.stdout).info(
+        f"rank {jax.process_index()}/{jax.process_count()} up; "
+        f"{jax.local_device_count()} local / {jax.device_count()} "
+        f"global devices", rank=jax.process_index())
     return 0
 
 

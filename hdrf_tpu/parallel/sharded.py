@@ -36,6 +36,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from hdrf_tpu.ops import gear
+from hdrf_tpu.utils import device_ledger as _ledger
 
 WINDOW = gear.WINDOW
 _HALO = WINDOW - 1
@@ -44,7 +45,10 @@ _HALO = WINDOW - 1
 def _put_global(arr: np.ndarray, sharding) -> jax.Array:
     """Host array -> sharded jax.Array; in multi-process mode each rank
     feeds only its addressable shards (parallel/launch.py runs the host
-    stages replicated, so every rank holds the same logical array)."""
+    stages replicated, so every rank holds the same logical array).  The
+    single H2D chokepoint of the sharded pipeline — ledger transfer
+    accounting lives here so callers never double-count."""
+    _ledger.transfer("h2d", "sharded.put", arr.nbytes)
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
     return jax.make_array_from_callback(arr.shape, sharding,
@@ -333,9 +337,11 @@ def reduce_sharded(data: bytes | np.ndarray, cdc, mesh: Mesh):
     buf = np.zeros(n + ((-n) % grid), dtype=np.uint8)
     buf[:n] = a
     block_sh = _put_global(buf, NamedSharding(mesh, P("seq")))
+    ev = _ledger.dispatch("sharded.scan", key=(buf.size, n_seq))
     words, _ = candidate_words_sharded(mesh)(
         block_sh, jnp.uint32(mask & 0xFFFFFFFF))
     wv = _fetch_global(words)
+    _ledger.readback(ev, d2h_bytes=wv.nbytes)
     (idx,) = np.nonzero(wv)
     pos = gear._words_to_positions(idx.astype(np.uint32), wv[idx], n)
     cuts = native.cdc_select(pos, n, cdc.min_chunk, cdc.max_chunk)
@@ -382,7 +388,10 @@ def reduce_sharded(data: bytes | np.ndarray, cdc, mesh: Mesh):
         fn = _sha_chunks_halo(mesh, bucket, pad_words, halo)
         ol_dev = _put_global(
             ol_all, NamedSharding(mesh, P("data", "seq")))
+        ev = _ledger.dispatch("sharded.sha", batch=nchunks,
+                              key=(bucket, lmax, halo))
         out = _fetch_global(fn(block_sh, ol_dev))
+        _ledger.readback(ev, d2h_bytes=out.nbytes)
         digests = out[(d_arr * n_seq + owner_seq) * lmax + j_arr]
         return cuts, digests
     # tiny blocks / shards smaller than the gather window: the halo walk
@@ -395,7 +404,10 @@ def reduce_sharded(data: bytes | np.ndarray, cdc, mesh: Mesh):
     fn = _sha_chunks_sharded(mesh, bucket, pad_words)
     ol_dev = _put_global(
         ol, NamedSharding(mesh, P(None, tuple(mesh.axis_names))))
-    digests = _fetch_global(fn(block_sh, ol_dev))[:nchunks]
+    ev = _ledger.dispatch("sharded.sha", batch=nchunks, key=(bucket, L))
+    digests = _fetch_global(fn(block_sh, ol_dev))
+    _ledger.readback(ev, d2h_bytes=digests.nbytes)
+    digests = digests[:nchunks]
     return cuts, digests
 
 

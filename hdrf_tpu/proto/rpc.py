@@ -16,6 +16,7 @@ thread-per-DataXceiver design (DataXceiverServer.java:44).
 
 from __future__ import annotations
 
+import contextlib
 import socket
 import socketserver
 import struct
@@ -66,6 +67,11 @@ def recv_frame(sock: socket.socket) -> Any:
                            strict_map_key=False)
 
 
+@contextlib.contextmanager
+def _null_ctx():
+    yield
+
+
 class RpcServer:
     """Serves ``rpc_*`` methods of a service object.
 
@@ -74,11 +80,16 @@ class RpcServer:
     >>> srv = RpcServer("127.0.0.1", 0, Svc(), "test"); srv.start()
     """
 
-    def __init__(self, host: str, port: int, service: Any, name: str):
+    def __init__(self, host: str, port: int, service: Any, name: str,
+                 watchdog: Any | None = None):
+        """``watchdog``: optional utils.watchdog.StallWatchdog — every
+        dispatched method is tracked so handler threads wedged past the
+        budget (VM write-burst stalls) surface in stall_total/stacks."""
         self._service = service
         self._name = name
         self._metrics = metrics.registry(f"rpc.{name}")
         self._tracer = tracing.tracer(f"rpc.{name}")
+        self._watchdog = watchdog
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -138,7 +149,11 @@ class RpcServer:
             if cached is not None:
                 self._metrics.incr("retry_cache_hits")
                 return [req_id, *cached]
-        with self._tracer.span(method, parent=tuple(trace) if trace else None):
+        track = (self._watchdog.track(f"rpc.{method}")
+                 if self._watchdog is not None else _null_ctx())
+        with track, \
+                self._tracer.span(method,
+                                  parent=tuple(trace) if trace else None):
             try:
                 with self._metrics.time(f"{method}_us"):
                     result = fn(**kwargs)

@@ -277,9 +277,14 @@ class BlockReceiver:
                 precomputed = _dispatch.chunk_and_fingerprint(
                     _np.frombuffer(data, dtype=_np.uint8),
                     dn.reduction_ctx.config.cdc, dn.reduction_ctx.backend)
+            # parent: the ambient xceiver span when _xceive opened one
+            # (Tracer.span falls back to it), else resume the wire context
+            # directly (continueTraceSpan, Receiver.java:94-98)
             with _TR.span("reduce_block",
                           parent=tuple(fields["_trace"])
-                          if fields.get("_trace") else None) as sp:
+                          if fields.get("_trace")
+                          and tracing.current_context() is None
+                          else None) as sp:
                 sp.annotate("block_id", block_id)
                 sp.annotate("scheme", scheme_name)
                 status = self._store_and_mirror(

@@ -40,9 +40,12 @@ from hdrf_tpu.reduction import scheme as schemes
 from hdrf_tpu.reduction.scheme import ReductionContext, ReductionScheme
 from hdrf_tpu.server.block_receiver import BlockReceiver
 from hdrf_tpu.server.block_sender import BlockSender
-from hdrf_tpu.utils import fault_injection, metrics
+from hdrf_tpu.server.status_http import StatusHttpServer
+from hdrf_tpu.utils import device_ledger, fault_injection, metrics, tracing
+from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("datanode")
+_TR = tracing.tracer("datanode")
 
 
 class PinnedCache:
@@ -278,6 +281,18 @@ class DataNode:
 
         self._server = Server((config.host, config.port), Handler)
         self._conns: set[socket.socket] = set()
+        # Stall watchdog over in-flight xceiver ops (DataXceiver has no
+        # analog; ours exists because the VM's write-burst throttling can
+        # stall any op ~35 s — PERF_NOTES round 4) + optional per-daemon
+        # status HTTP endpoint (HttpServer2 analog).
+        self.watchdog = StallWatchdog(self.dn_id,
+                                      budget_s=config.stall_budget_s,
+                                      registry=_M)
+        self._status = None
+        if config.status_port is not None:
+            self._status = StatusHttpServer(self.dn_id, host=config.host,
+                                            port=config.status_port,
+                                            watchdog=self.watchdog)
         from hdrf_tpu.server.shortcircuit import ShortCircuitServer
         self._sc = ShortCircuitServer(
             self, os.path.join(config.data_dir, "sc.sock"))
@@ -295,6 +310,9 @@ class DataNode:
         t.start()
         self._threads.append(t)
         self._sc.start()
+        self.watchdog.start()
+        if self._status is not None:
+            self._status.start()
         self._register()
         hb = threading.Thread(target=self._heartbeat_loop,
                               name=f"{self.dn_id}-heartbeat", daemon=True)
@@ -341,6 +359,9 @@ class DataNode:
 
     def stop(self) -> None:
         self._stop.set()
+        self.watchdog.stop()
+        if self._status is not None:
+            self._status.stop()
         self._sc.stop()
         self._sc.stop_registry()
         self._server.shutdown()
@@ -469,81 +490,20 @@ class DataNode:
             sock.close()
             return
         fault_injection.point("datanode.op", op=op)
+        trace = fields.get("_trace")
         try:
-            if op == dt.WRITE_BLOCK:
-                self.tokens.verify(fields.get("token"), fields["block_id"], "w")
-                if fields["scheme"] == "direct":
-                    self._receiver.receive_direct(sock, fields)
-                else:
-                    self._receiver.receive_reduced(sock, fields)
-            elif op == "write_reduced":
-                self.tokens.verify(fields.get("token"), fields["block_id"], "w")
-                self._receiver.ingest_reduced(sock, fields)
-            elif op == dt.READ_BLOCK:
-                self.tokens.verify(fields.get("token"), fields["block_id"], "r")
-                self._sender.serve_read(sock, fields)
-            elif op == dt.BLOCK_CHECKSUM:
-                self.tokens.verify(fields.get("token"), fields["block_id"],
-                                   "r")
-                self._serve_checksum(sock, fields)
-            elif op == "replica_info":
-                self.tokens.verify(fields.get("token"), fields["block_id"], "r")
-                meta = self.replicas.get_meta(fields["block_id"])
-                send_frame(sock, {"length": meta.logical_len if meta else -1,
-                                  "gen_stamp": meta.gen_stamp if meta else -1,
-                                  "rbw": self.replicas.is_rbw(
-                                      fields["block_id"])})
-            elif op == "alias_add":
-                # provided-storage mount push (the live-cluster form of
-                # the reference's offline alias-map generation): persist
-                # the regions, report them immediately via IBR.  Gated by
-                # per-region WRITE block tokens (minted by the superuser-
-                # only rpc_provide_file) — without the check, anyone with
-                # DN network access could repoint provided blocks at
-                # arbitrary local files
-                from hdrf_tpu.storage.aliasmap import FileRegion
-                regions = [FileRegion.unpack(v) for v in fields["regions"]]
-                tokens = fields.get("tokens") or [None] * len(regions)
-                for reg, tok in zip(regions, tokens):
-                    self.tokens.verify(tok, reg.block_id, "w")
-                try:
-                    for reg in regions:
-                        self.aliasmap.check_uri(reg.uri)
-                except IOError as e:
-                    _M.incr("alias_rejects")
-                    send_frame(sock, {"ok": False, "error": str(e)})
-                    return
-                self.aliasmap.write(regions)
-                for reg in regions:
-                    self.notify_block_received(reg.block_id, reg.length, 0,
-                                               storage_type="PROVIDED")
-                send_frame(sock, {"ok": True, "count": len(regions)})
-            elif op == "reconfigure":
-                send_frame(sock, self.reconfigure(fields.get("key", ""),
-                                                  fields.get("value")))
-            elif op == "get_reconfigurable":
-                send_frame(sock, {"keys": sorted(self.RECONFIGURABLE)})
-            elif op == "disk_balance":
-                # intra-DN volume evening (diskbalancer -plan/-execute in
-                # one round trip; like the DN protocol, trusted within the
-                # deployment perimeter rather than block-token gated)
-                plan = self.volumes.plan_moves(
-                    float(fields.get("threshold", 0.10)))
-                moved = self.volumes.execute_moves(plan)
-                send_frame(sock, {
-                    "planned": len(plan), "moved": moved,
-                    "volumes": [{"vol": v.vol_id, "type": v.storage_type,
-                                 "used": v.used_bytes(),
-                                 "failed": v.failed}
-                                for v in self.volumes.volumes]})
-            elif op == "truncate_replica":
-                self.tokens.verify(fields.get("token"), fields["block_id"], "w")
-                ok = self.replicas.truncate_replica(
-                    fields["block_id"], fields["length"],
-                    new_gs=fields.get("new_gen_stamp"))
-                send_frame(sock, {"ok": ok})
-            else:
-                _M.incr("unknown_ops")
+            if op == "trace_spans":
+                # Observability poll (gateway /traces fan-out): serve the
+                # local span sink + device ledger, proxying the co-located
+                # worker's so callers never need the worker addr.  Served
+                # OUTSIDE the xceiver span so polling never pollutes traces.
+                self._serve_trace_spans(sock)
+                return
+            with self.watchdog.track(f"xceiver.{op}"), \
+                    _TR.span(f"xceiver.{op}",
+                             parent=tuple(trace) if trace else None) as sp:
+                sp.annotate("dn_id", self.dn_id)
+                self._dispatch_op(sock, op, fields)
         except PermissionError:
             _M.incr("op_auth_failures")
         except (ConnectionError, OSError):
@@ -552,6 +512,98 @@ class DataNode:
             _M.incr("op_errors")
         finally:
             sock.close()
+
+    def _serve_trace_spans(self, sock: socket.socket) -> None:
+        out = {"daemon": self.dn_id,
+               "spans": tracing.all_span_snapshots(),
+               "ledger": device_ledger.events_snapshot()}
+        if self._worker is not None:
+            try:
+                w = self._worker.traces()
+                out["spans"] = out["spans"] + list(w.get("spans") or ())
+                out["ledger"] = out["ledger"] + list(w.get("ledger") or ())
+            except Exception:  # worker down: local view still serves
+                _M.incr("worker_trace_failures")
+        send_frame(sock, out)
+
+    def _dispatch_op(self, sock: socket.socket, op, fields: dict) -> None:
+        """Xceiver op chain (Receiver.java:101-135 dispatch analog).  The
+        caller (_xceive) owns the socket lifetime, the xceiver span, the
+        watchdog tracking and the exception accounting."""
+        if op == dt.WRITE_BLOCK:
+            self.tokens.verify(fields.get("token"), fields["block_id"], "w")
+            if fields["scheme"] == "direct":
+                self._receiver.receive_direct(sock, fields)
+            else:
+                self._receiver.receive_reduced(sock, fields)
+        elif op == "write_reduced":
+            self.tokens.verify(fields.get("token"), fields["block_id"], "w")
+            self._receiver.ingest_reduced(sock, fields)
+        elif op == dt.READ_BLOCK:
+            self.tokens.verify(fields.get("token"), fields["block_id"], "r")
+            self._sender.serve_read(sock, fields)
+        elif op == dt.BLOCK_CHECKSUM:
+            self.tokens.verify(fields.get("token"), fields["block_id"],
+                               "r")
+            self._serve_checksum(sock, fields)
+        elif op == "replica_info":
+            self.tokens.verify(fields.get("token"), fields["block_id"], "r")
+            meta = self.replicas.get_meta(fields["block_id"])
+            send_frame(sock, {"length": meta.logical_len if meta else -1,
+                              "gen_stamp": meta.gen_stamp if meta else -1,
+                              "rbw": self.replicas.is_rbw(
+                                  fields["block_id"])})
+        elif op == "alias_add":
+            # provided-storage mount push (the live-cluster form of
+            # the reference's offline alias-map generation): persist
+            # the regions, report them immediately via IBR.  Gated by
+            # per-region WRITE block tokens (minted by the superuser-
+            # only rpc_provide_file) — without the check, anyone with
+            # DN network access could repoint provided blocks at
+            # arbitrary local files
+            from hdrf_tpu.storage.aliasmap import FileRegion
+            regions = [FileRegion.unpack(v) for v in fields["regions"]]
+            tokens = fields.get("tokens") or [None] * len(regions)
+            for reg, tok in zip(regions, tokens):
+                self.tokens.verify(tok, reg.block_id, "w")
+            try:
+                for reg in regions:
+                    self.aliasmap.check_uri(reg.uri)
+            except IOError as e:
+                _M.incr("alias_rejects")
+                send_frame(sock, {"ok": False, "error": str(e)})
+                return
+            self.aliasmap.write(regions)
+            for reg in regions:
+                self.notify_block_received(reg.block_id, reg.length, 0,
+                                           storage_type="PROVIDED")
+            send_frame(sock, {"ok": True, "count": len(regions)})
+        elif op == "reconfigure":
+            send_frame(sock, self.reconfigure(fields.get("key", ""),
+                                              fields.get("value")))
+        elif op == "get_reconfigurable":
+            send_frame(sock, {"keys": sorted(self.RECONFIGURABLE)})
+        elif op == "disk_balance":
+            # intra-DN volume evening (diskbalancer -plan/-execute in
+            # one round trip; like the DN protocol, trusted within the
+            # deployment perimeter rather than block-token gated)
+            plan = self.volumes.plan_moves(
+                float(fields.get("threshold", 0.10)))
+            moved = self.volumes.execute_moves(plan)
+            send_frame(sock, {
+                "planned": len(plan), "moved": moved,
+                "volumes": [{"vol": v.vol_id, "type": v.storage_type,
+                             "used": v.used_bytes(),
+                             "failed": v.failed}
+                            for v in self.volumes.volumes]})
+        elif op == "truncate_replica":
+            self.tokens.verify(fields.get("token"), fields["block_id"], "w")
+            ok = self.replicas.truncate_replica(
+                fields["block_id"], fields["length"],
+                new_gs=fields.get("new_gen_stamp"))
+            send_frame(sock, {"ok": ok})
+        else:
+            _M.incr("unknown_ops")
 
     def _serve_checksum(self, sock: socket.socket, fields: dict) -> None:
         from hdrf_tpu.proto.rpc import send_frame

@@ -23,6 +23,14 @@ protocols, with the reference's protocol shapes:
   CANCELDELEGATIONTOKEN.
 
   GET  /status   cluster overview; GET /metrics  JMX/metrics2 analog;
+  GET  /prom     Prometheus text exposition (gateway + NameNode registries,
+                 the PrometheusMetricsSink analog);
+  GET  /traces   cross-daemon trace assembly: local + NameNode + every live
+                 DataNode's spans and device-ledger events merged by
+                 trace_id (``?trace_id=`` filters; ``?format=chrome``
+                 renders Chrome/Perfetto trace_event JSON) — the pull-model
+                 replacement for the reference's HTrace span receivers;
+  GET  /stacks   live thread stacks (HttpServer2 StackServlet analog);
   /dfshealth /datanode /journal /explorer  web UIs.
 """
 
@@ -37,7 +45,7 @@ from urllib.parse import parse_qs, quote, unquote, urlparse
 import msgpack
 
 from hdrf_tpu.client.filesystem import HdrfClient
-from hdrf_tpu.utils import metrics
+from hdrf_tpu.utils import device_ledger, metrics, prom, tracing
 
 _M = metrics.registry("http_gateway")
 PREFIX = "/webhdfs/v1"
@@ -101,6 +109,14 @@ class HttpGateway:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _text(self, body: str, ctype: str) -> None:
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def _dispatch(self, method: str) -> None:
                 _M.incr("requests")
                 u = urlparse(self.path)
@@ -120,6 +136,18 @@ class HttpGateway:
                         return self._json(200, gateway.status())
                     if u.path == "/metrics":
                         return self._json(200, gateway.metrics())
+                    if u.path == "/prom":
+                        return self._text(gateway.prom_text(),
+                                          "text/plain; version=0.0.4")
+                    if u.path == "/traces":
+                        out = gateway.traces(trace_id=q.get("trace_id"))
+                        if q.get("format") == "chrome":
+                            out = tracing.chrome_trace(
+                                out["spans"], out["ledger"],
+                                trace_id=q.get("trace_id"))
+                        return self._json(200, out)
+                    if u.path == "/stacks":
+                        return self._json(200, gateway.stacks())
                     if not u.path.startswith(PREFIX):
                         return self._json(404, {"error": "not found"})
                     path = unquote(u.path[len(PREFIX):]) or "/"
@@ -402,13 +430,83 @@ class HttpGateway:
         with HdrfClient(self._nn_addr, name="http-gw") as c:
             return c._call("metrics")
 
+    def prom_text(self) -> str:
+        """Prometheus exposition over the gateway's own registries merged
+        with the NameNode's (same-name registries keep the gateway-local
+        view; they are the same families either way)."""
+        snaps = dict(metrics.all_snapshots())
+        try:
+            with HdrfClient(self._nn_addr, name="http-gw") as c:
+                for name, snap in c._call("metrics").items():
+                    snaps.setdefault(name, snap)
+        except (OSError, ConnectionError):
+            _M.incr("prom_nn_unreachable")
+        return prom.render(snaps)
+
+    def traces(self, trace_id: str | None = None) -> dict:
+        """Cross-daemon trace assembly: this process's spans + ledger,
+        the NameNode's (trace_spans RPC), and every live DataNode's
+        (trace_spans xceiver op; each DN proxies its co-located worker).
+        Spans dedupe by span_id, ledger events by (proc, id) — a daemon
+        polled twice (e.g. NN also reachable as a peer) merges clean."""
+        import socket as _socket
+
+        from hdrf_tpu.proto import datatransfer as dt
+        from hdrf_tpu.proto.rpc import recv_frame
+
+        spans = list(tracing.all_span_snapshots())
+        ledger = list(device_ledger.events_snapshot())
+        report = []
+        try:
+            with HdrfClient(self._nn_addr, name="http-gw") as c:
+                report = c.datanode_report()
+                nn = c._call("trace_spans")
+                spans.extend(nn.get("spans") or ())
+                ledger.extend(nn.get("ledger") or ())
+        except (OSError, ConnectionError):
+            _M.incr("traces_nn_unreachable")
+        for d in report:
+            if not d.get("alive"):
+                continue
+            try:
+                with _socket.create_connection(
+                        tuple(d["addr"]), timeout=5.0) as s:
+                    dt.send_op(s, "trace_spans")
+                    out = recv_frame(s)
+                spans.extend(out.get("spans") or ())
+                ledger.extend(out.get("ledger") or ())
+            except (OSError, ConnectionError):
+                _M.incr("traces_dn_unreachable")
+        seen_sp: set = set()
+        seen_ev: set = set()
+        uspans = [s for s in spans
+                  if s.get("span_id") not in seen_sp
+                  and not seen_sp.add(s.get("span_id"))]
+        uledger = [e for e in ledger
+                   if (e.get("proc"), e.get("id")) not in seen_ev
+                   and not seen_ev.add((e.get("proc"), e.get("id")))]
+        if trace_id is not None:
+            uspans = [s for s in uspans if s.get("trace_id") == trace_id]
+            uledger = [e for e in uledger
+                       if e.get("trace_id") == trace_id]
+        return {"spans": uspans, "ledger": uledger}
+
+    def stacks(self) -> dict:
+        """Gateway-process thread stacks (per-daemon stacks live on each
+        daemon's own status endpoint)."""
+        from hdrf_tpu.utils.watchdog import thread_stacks
+
+        return {"daemon": "http_gateway", "threads": thread_stacks()}
+
     # ------------------------------------------------------------- web UIs
 
     _NAV = ('<p><a href="/dfshealth">[overview]</a> '
             '<a href="/explorer?path=%2F">[explorer]</a> '
             '<a href="/journal">[journal]</a> '
             '<a href="/status">[status.json]</a> '
-            '<a href="/metrics">[metrics.json]</a></p>')
+            '<a href="/metrics">[metrics.json]</a> '
+            '<a href="/prom">[prom]</a> '
+            '<a href="/traces">[traces]</a></p>')
 
     @staticmethod
     def _page(title: str, body: str) -> str:

@@ -34,7 +34,8 @@ from hdrf_tpu.proto.rpc import RpcError, RpcServer
 from hdrf_tpu.server import permissions as perm
 from hdrf_tpu.server.editlog import EditLog
 from hdrf_tpu.server.permissions import Attrs, DirNode
-from hdrf_tpu.utils import fault_injection, metrics
+from hdrf_tpu.utils import fault_injection, metrics, tracing
+from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("namenode")
 
@@ -349,7 +350,22 @@ class NameNode:
         for _name in dir(type(self)):
             if _name.startswith("rpc_"):
                 setattr(self, _name, self._sync_wrap(getattr(self, _name)))
-        self._rpc = RpcServer(self.config.host, self.config.port, self, "namenode")
+        # Stall watchdog over in-flight RPC handlers (the VM's write-burst
+        # throttling can wedge any fsync-bearing handler ~35 s — PERF_NOTES
+        # round 4); optional per-daemon status HTTP endpoint (HttpServer2).
+        self.watchdog = StallWatchdog("namenode",
+                                      budget_s=self.config.stall_budget_s,
+                                      registry=_M)
+        self._rpc = RpcServer(self.config.host, self.config.port, self,
+                              "namenode", watchdog=self.watchdog)
+        self._status = None
+        if self.config.status_port is not None:
+            from hdrf_tpu.server.status_http import StatusHttpServer
+
+            self._status = StatusHttpServer("namenode",
+                                            host=self.config.host,
+                                            port=self.config.status_port,
+                                            watchdog=self.watchdog)
         self._monitor_stop = threading.Event()
         self._monitor: threading.Thread | None = None
 
@@ -357,6 +373,9 @@ class NameNode:
 
     def start(self) -> "NameNode":
         self._rpc.start()
+        self.watchdog.start()
+        if self._status is not None:
+            self._status.start()
         target = (self._monitor_loop if self.role == "active"
                   else self._tailer_loop)
         self._monitor = threading.Thread(target=target, name="nn-monitor",
@@ -366,6 +385,9 @@ class NameNode:
 
     def stop(self) -> None:
         self._monitor_stop.set()
+        self.watchdog.stop()
+        if self._status is not None:
+            self._status.stop()
         if self._monitor:
             self._monitor.join(timeout=5)
         self._rpc.stop()
@@ -2809,6 +2831,16 @@ class NameNode:
 
     def rpc_metrics(self) -> dict:
         return metrics.all_snapshots()
+
+    def rpc_trace_spans(self) -> dict:
+        """This process's finished spans + device-ledger events, for the
+        gateway's cross-daemon /traces merge (the span-receiver pull model
+        replacing the reference's HTrace push receivers)."""
+        from hdrf_tpu.utils import device_ledger
+
+        return {"daemon": "namenode",
+                "spans": tracing.all_span_snapshots(),
+                "ledger": device_ledger.events_snapshot()}
 
     # Absolute slowness floor for the no-baseline rule: a peer whose median
     # downstream transfer is worse than 1 MB/s is pathological regardless of

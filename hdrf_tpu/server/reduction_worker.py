@@ -36,9 +36,10 @@ import numpy as np
 from hdrf_tpu.config import CdcConfig
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import recv_frame, send_frame
-from hdrf_tpu.utils import metrics
+from hdrf_tpu.utils import metrics, tracing
 
 _M = metrics.registry("reduction_worker")
+_TR = tracing.tracer("reduction_worker")
 
 # Device upload stride for streaming ingest: big enough to amortize the
 # per-transfer cost, small enough that HBM staging overlaps the tail of
@@ -80,6 +81,9 @@ class ReductionWorker:
 
         self._server = Server((host, port), Handler)
         self._thread: threading.Thread | None = None
+        from hdrf_tpu.utils.watchdog import StallWatchdog
+
+        self.watchdog = StallWatchdog("reduction_worker", registry=_M)
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -89,9 +93,11 @@ class ReductionWorker:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="reduction-worker", daemon=True)
         self._thread.start()
+        self.watchdog.start()
         return self
 
     def stop(self) -> None:
+        self.watchdog.stop()
         self._server.shutdown()
         self._server.server_close()
 
@@ -99,18 +105,35 @@ class ReductionWorker:
 
     def _dispatch(self, sock: socket.socket, req: dict) -> None:
         op = req.get("op")
+        # Resume the DN-side span carried in the request frame (the op-header
+        # continueTraceSpan pattern, Receiver.java:94-98, extended across the
+        # DN->worker process boundary) — only around compute ops so ping /
+        # stats / trace polls never pollute the span sink.
+        trace = req.get("_trace")
         try:
-            if op == "reduce":
-                self._op_reduce(sock, req)
-            elif op == "compress":
-                self._op_compress(sock, req)
-            elif op == "compress_batch":
-                self._op_compress_batch(sock, req)
+            if op in ("reduce", "compress", "compress_batch"):
+                with self.watchdog.track(f"worker.{op}"), \
+                        _TR.span(f"worker.{op}",
+                                 parent=tuple(trace) if trace else None) as sp:
+                    sp.annotate("backend", self.backend)
+                    if op == "reduce":
+                        self._op_reduce(sock, req)
+                    elif op == "compress":
+                        self._op_compress(sock, req)
+                    else:
+                        self._op_compress_batch(sock, req)
             elif op == "ping":
                 send_frame(sock, {"ok": True, "backend": self.backend})
             elif op == "stats":
                 with self._stats_lock:
                     send_frame(sock, dict(self._stats))
+            elif op == "traces":
+                from hdrf_tpu.utils import device_ledger
+
+                send_frame(sock, {
+                    "daemon": "reduction_worker",
+                    "spans": tracing.all_span_snapshots(),
+                    "ledger": device_ledger.events_snapshot()})
             else:
                 send_frame(sock, {"error": "NoSuchOp", "message": str(op)})
         except (ConnectionError, OSError):
@@ -269,6 +292,16 @@ class WorkerClient:
                 f"worker: {resp['error']}: {resp['message']}")
         return resp
 
+    @staticmethod
+    def _traced(req: dict) -> dict:
+        """Stamp the caller's span context into the request frame (same
+        contract as dt.send_op headers / RpcClient.call), so the worker's
+        span nests under the DN pipeline span that drove it."""
+        tr = tracing.current_context()
+        if tr is not None:
+            req["_trace"] = list(tr)
+        return req
+
     def reduce_stream(self, packets, cdc: CdcConfig):
         """Forward an iterator of byte packets; returns (cuts, digests).
         This is the true streaming path: the DN calls it from inside its
@@ -281,9 +314,10 @@ class WorkerClient:
         s = self._conn()
         try:
             try:
-                send_frame(s, {"op": "reduce", "mask_bits": cdc.mask_bits,
-                               "min_chunk": cdc.min_chunk,
-                               "max_chunk": cdc.max_chunk})
+                send_frame(s, self._traced(
+                    {"op": "reduce", "mask_bits": cdc.mask_bits,
+                     "min_chunk": cdc.min_chunk,
+                     "max_chunk": cdc.max_chunk}))
             except OSError as e:
                 raise WorkerError(f"worker send failed: {e}") from e
             seq = 0
@@ -321,7 +355,8 @@ class WorkerClient:
         s = self._conn()
         try:
             try:
-                send_frame(s, {"op": "compress", "codec": codec})
+                send_frame(s, self._traced({"op": "compress",
+                                            "codec": codec}))
                 dt.stream_bytes(s, data, 1 << 20)
                 out = bytes(self._checked(recv_frame(s))["data"])
             except (OSError, ConnectionError) as e:
@@ -338,8 +373,9 @@ class WorkerClient:
         s = self._conn()
         try:
             try:
-                send_frame(s, {"op": "compress_batch", "codec": codec,
-                               "sizes": [len(d) for d in datas]})
+                send_frame(s, self._traced(
+                    {"op": "compress_batch", "codec": codec,
+                     "sizes": [len(d) for d in datas]}))
                 seq = 0
                 for d in datas:
                     if d:
@@ -371,6 +407,19 @@ class WorkerClient:
         s = self._conn()
         try:
             send_frame(s, {"op": "stats"})
+            out = self._checked(recv_frame(s))
+            self._release(s)
+            return out
+        except BaseException:
+            s.close()
+            raise
+
+    def traces(self) -> dict:
+        """Worker-process spans + device-ledger events (the DN proxies this
+        through its own trace_spans op for the gateway merge)."""
+        s = self._conn()
+        try:
+            send_frame(s, {"op": "traces"})
             out = self._checked(recv_frame(s))
             self._release(s)
             return out
@@ -414,8 +463,16 @@ def main(argv=None) -> int:
     p.add_argument("--backend", default="auto")
     args = p.parse_args(argv)
     w = ReductionWorker(args.host, args.port, backend=args.backend).start()
-    print(f"reduction worker ({w.backend}) listening on "
-          f"{w.addr[0]}:{w.addr[1]}", flush=True)
+    # Startup banner goes to STDOUT (spawn_local_worker regex-parses the
+    # "listening on host:port" substring off the first line — present in
+    # both the text and JSON log formats).
+    import sys
+
+    from hdrf_tpu.utils import log
+
+    log.get_logger("reduction_worker", stream=sys.stdout).info(
+        f"reduction worker ({w.backend}) listening on "
+        f"{w.addr[0]}:{w.addr[1]}", backend=w.backend)
     try:
         while True:
             import time

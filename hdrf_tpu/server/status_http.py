@@ -1,0 +1,106 @@
+"""Per-daemon status HTTP server: /prom, /metrics, /traces, /stacks.
+
+Equivalent of the reference's per-daemon HttpServer2 servlet set (every
+Hadoop daemon serves /jmx, /metrics, /stacks and /conf on its info port;
+DataNode.java wires it at startup): a tiny threaded HTTP server each daemon
+opts into via ``status_port`` config, serving
+
+- ``/prom``    — Prometheus text exposition over this process's registries
+  (utils/prom.py; the PrometheusMetricsSink analog),
+- ``/metrics`` — raw JSON registry snapshots (the /jmx analog),
+- ``/traces``  — this process's finished spans + device-ledger events
+  (raw JSON; the gateway's /traces merges these across daemons),
+- ``/stacks``  — live thread stacks plus the watchdog's recent stall
+  captures (the HttpServer2 StackServlet analog).
+
+The server threads are daemonic and shut down with the owning daemon.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from hdrf_tpu.utils import device_ledger, metrics, prom, tracing
+from hdrf_tpu.utils.watchdog import StallWatchdog, thread_stacks
+
+
+class StatusHttpServer:
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
+                 watchdog: StallWatchdog | None = None):
+        self.name = name
+        self._watchdog = watchdog
+        status = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                if u.path == "/prom":
+                    body = prom.render(metrics.all_snapshots()).encode()
+                    return self._send(200, body,
+                                      "text/plain; version=0.0.4")
+                if u.path == "/metrics":
+                    return self._send(
+                        200, json.dumps(metrics.all_snapshots()).encode(),
+                        "application/json")
+                if u.path == "/traces":
+                    out = status.traces(trace_id=q.get("trace_id"))
+                    if q.get("format") == "chrome":
+                        out = tracing.chrome_trace(out["spans"],
+                                                   out["ledger"],
+                                                   trace_id=q.get("trace_id"))
+                    return self._send(200, json.dumps(out).encode(),
+                                      "application/json")
+                if u.path == "/stacks":
+                    return self._send(200,
+                                      json.dumps(status.stacks()).encode(),
+                                      "application/json")
+                self._send(404, b'{"error": "not found"}',
+                           "application/json")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"status-http-{name}", daemon=True)
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._server.server_address
+
+    def start(self) -> "StatusHttpServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def traces(self, trace_id: str | None = None) -> dict:
+        spans = tracing.all_span_snapshots()
+        ledger = device_ledger.events_snapshot()
+        if trace_id is not None:
+            spans = [s for s in spans if s["trace_id"] == trace_id]
+            ledger = [e for e in ledger if e.get("trace_id") == trace_id]
+        return {"daemon": self.name, "spans": spans, "ledger": ledger}
+
+    def stacks(self) -> dict:
+        out = {"daemon": self.name, "threads": thread_stacks()}
+        if self._watchdog is not None:
+            out["stalls"] = self._watchdog.stalls()
+            out["inflight"] = self._watchdog.inflight()
+        return out

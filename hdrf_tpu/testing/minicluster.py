@@ -30,6 +30,8 @@ class MiniCluster:
                  volume_types: list[str] | None = None,
                  nameservices: int = 1,
                  tpu_worker: bool = False,
+                 worker_backend: str = "auto",
+                 backend: str | None = None,
                  dn_config_overrides: dict | None = None):
         """``journal_nodes`` > 0 boots that many JournalNodes and puts the
         edit log on the quorum (MiniQJMHACluster analog); each NN then gets
@@ -40,7 +42,10 @@ class MiniCluster:
         for storage-policy tests.  ``tpu_worker`` spawns ONE co-located
         reduction-worker PROCESS shared by every DN (the north-star
         out-of-process deployment; backend auto-resolves — native on the
-        CPU test mesh, device on a real chip)."""
+        CPU test mesh, device on a real chip).  ``worker_backend`` pins
+        the worker's backend (e.g. ``"tpu"`` to force the jax path on a
+        virtual-device mesh); ``backend`` pins the DNs' in-process
+        reduction backend (default stays the deterministic native)."""
         self.n_datanodes = n_datanodes
         self.ha = ha
         self.n_journal = journal_nodes
@@ -50,6 +55,8 @@ class MiniCluster:
         self.volume_types = volume_types
         self.dn_config_overrides = dn_config_overrides or {}
         self.tpu_worker = tpu_worker
+        self.worker_backend = worker_backend
+        self.backend = backend
         self._worker_proc = None
         self._worker_addr = None
         self._own_dir = base_dir is None
@@ -82,7 +89,8 @@ class MiniCluster:
         if self.tpu_worker:
             from hdrf_tpu.server.reduction_worker import spawn_local_worker
 
-            self._worker_proc, self._worker_addr = spawn_local_worker()
+            self._worker_proc, self._worker_addr = spawn_local_worker(
+                backend=self.worker_backend)
         if self.n_journal:
             from hdrf_tpu.server.journal import JournalNode
 
@@ -161,7 +169,7 @@ class MiniCluster:
             # secure default (no mount root = file:// aliasing disabled)
             provided_mount_root="/")
         cfg.reduction.container_size = self._dn_kw["container_size"]
-        cfg.reduction.backend = "native"  # deterministic in tests
+        cfg.reduction.backend = self.backend or "native"  # deterministic
         if self._worker_addr is not None:
             cfg.reduction.worker_addr = list(self._worker_addr)
         cfg.encrypt_data_transfer = self.secure

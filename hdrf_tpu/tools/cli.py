@@ -58,7 +58,12 @@ def cmd_namenode(args) -> int:
     if args.port is not None:
         cfg.namenode.port = args.port
     nn = NameNode(cfg.namenode).start()
-    print(f"namenode listening on {nn.addr[0]}:{nn.addr[1]}", flush=True)
+    # daemon banners go to STDOUT via the structured logger (tooling greps
+    # the "listening on host:port" substring, kept in both log formats)
+    from hdrf_tpu.utils import log
+
+    log.get_logger("namenode", stream=sys.stdout).info(
+        f"namenode listening on {nn.addr[0]}:{nn.addr[1]}")
     try:
         while True:
             time.sleep(3600)
@@ -75,8 +80,11 @@ def cmd_datanode(args) -> int:
     if args.data_dir:
         cfg.datanode.data_dir = args.data_dir
     dn = DataNode(cfg.datanode, _addr(args.namenode)).start()
-    print(f"datanode {dn.dn_id} listening on {dn.addr[0]}:{dn.addr[1]}",
-          flush=True)
+    from hdrf_tpu.utils import log
+
+    log.get_logger("datanode", stream=sys.stdout).info(
+        f"datanode {dn.dn_id} listening on {dn.addr[0]}:{dn.addr[1]}",
+        dn_id=dn.dn_id)
     try:
         while True:
             time.sleep(3600)
@@ -89,7 +97,10 @@ def cmd_httpfs(args) -> int:
     from hdrf_tpu.server.http_gateway import HttpGateway
 
     gw = HttpGateway(_addr(args.namenode), port=args.port).start()
-    print(f"http gateway on http://{gw.addr[0]}:{gw.addr[1]}", flush=True)
+    from hdrf_tpu.utils import log
+
+    log.get_logger("http_gateway", stream=sys.stdout).info(
+        f"http gateway on http://{gw.addr[0]}:{gw.addr[1]}")
     try:
         while True:
             time.sleep(3600)

@@ -53,9 +53,21 @@ class Histogram:
                 return float(self.BOUNDS[i]) if i < len(self.BOUNDS) else self.max
         return self.max
 
-    def snapshot(self) -> dict[str, float]:
-        return {"count": self.count, "mean": self.mean, "max": self.max,
-                "p50": self.quantile(0.50), "p99": self.quantile(0.99)}
+    def snapshot(self) -> dict[str, Any]:
+        """Snapshot with CUMULATIVE bucket counts (the Prometheus histogram
+        contract: each ``le`` bucket counts all observations <= the bound, and
+        the implicit ``+Inf`` bucket equals ``count``).  Only bounds where the
+        cumulative count increases are emitted, so 32 power-of-2 bounds don't
+        bloat every snapshot; p50/p99 stay for existing JSON consumers."""
+        buckets: list[list[float]] = []
+        cum = 0
+        for i, c in enumerate(self._buckets[:-1]):
+            if c:
+                cum += c
+                buckets.append([float(self.BOUNDS[i]), cum])
+        return {"count": self.count, "sum": self.total, "mean": self.mean,
+                "max": self.max, "p50": self.quantile(0.50),
+                "p99": self.quantile(0.99), "buckets": buckets}
 
 
 class MetricsRegistry:

@@ -6,6 +6,11 @@ server-side (Receiver.java:94-98 ``continueTraceSpan``). Here a span is
 ``(trace_id, span_id, parent_id, name, t0, t1)``; the wire carries
 ``(trace_id, span_id)`` in op headers, and finished spans accumulate in a
 bounded in-memory sink queryable from the HTTP status endpoint.
+
+``chrome_trace`` assembles span snapshots (plus device-ledger events,
+utils/device_ledger.py) into Chrome/Perfetto ``trace_event`` JSON — the
+export format the gateway's ``/traces?format=chrome`` serves, playing the
+role of the reference's HTrace span-receiver/Zipkin pipeline.
 """
 
 from __future__ import annotations
@@ -90,6 +95,7 @@ class Tracer:
             {
                 "trace_id": f"{s.trace_id:016x}", "span_id": f"{s.span_id:016x}",
                 "parent_id": f"{s.parent_id:016x}", "name": s.name,
+                "tracer": self.name,
                 "start": s.t0, "duration_ms": None if s.t1 is None else (s.t1 - s.t0) * 1e3,
                 "annotations": s.annotations,
             }
@@ -115,3 +121,65 @@ def tracer(name: str) -> Tracer:
         if t is None:
             t = _tracers[name] = Tracer(name)
         return t
+
+
+def all_span_snapshots() -> list[dict[str, Any]]:
+    """Finished spans from every tracer in this process (the per-process
+    contribution to the gateway's cross-daemon /traces merge)."""
+    with _tracers_lock:
+        ts = list(_tracers.values())
+    out: list[dict[str, Any]] = []
+    for t in ts:
+        out.extend(t.snapshot())
+    return out
+
+
+def chrome_trace(spans: list[dict[str, Any]],
+                 ledger: list[dict[str, Any]] = (),
+                 trace_id: str | None = None) -> dict[str, Any]:
+    """Assemble span snapshots + device-ledger events into Chrome
+    ``trace_event`` format (the ``chrome://tracing`` / Perfetto JSON schema:
+    ``M`` process-name metadata rows plus ``X`` complete events with
+    microsecond ``ts``/``dur``).  ``pid`` groups rows by tracer (spans) or
+    originating process (ledger events); ``tid`` groups by trace so one
+    write's causal chain reads as one row block.  ``args`` keeps the raw
+    trace/span/parent ids, so parent-chain assembly survives the export."""
+    if trace_id is not None:
+        spans = [s for s in spans if s["trace_id"] == trace_id]
+        ledger = [e for e in ledger if e.get("trace_id") == trace_id]
+    events: list[dict[str, Any]] = []
+    pids: dict[str, int] = {}
+
+    def pid_of(group: str) -> int:
+        if group not in pids:
+            pids[group] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pids[group], "tid": 0,
+                           "args": {"name": group}})
+        return pids[group]
+
+    for s in spans:
+        if s.get("duration_ms") is None:
+            continue
+        events.append({
+            "ph": "X", "name": s["name"], "cat": "span",
+            "pid": pid_of(s.get("tracer", "?")),
+            "tid": int(s["trace_id"][-8:], 16),
+            "ts": s["start"] * 1e6, "dur": s["duration_ms"] * 1e3,
+            "args": {"trace_id": s["trace_id"], "span_id": s["span_id"],
+                     "parent_id": s["parent_id"],
+                     **s.get("annotations", {})},
+        })
+    for e in ledger:
+        tid = int(e["trace_id"][-8:], 16) if e.get("trace_id") else 0
+        events.append({
+            "ph": "X", "name": f"{e['kind']}:{e['op']}",
+            "cat": "device_ledger",
+            "pid": pid_of(f"device:{e.get('proc', '?')}"),
+            "tid": tid, "ts": e["t0"] * 1e6,
+            "dur": max(e.get("dur_us", 0.0), 1.0),
+            "args": {"trace_id": e.get("trace_id"),
+                     "span_id": e.get("span_id"), "batch": e.get("batch"),
+                     "bytes": e.get("bytes"), "kind": e["kind"]},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

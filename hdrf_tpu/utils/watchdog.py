@@ -1,0 +1,145 @@
+"""Stall watchdog: flags in-flight operations that exceed their budget.
+
+Equivalent of the reference's slow-node/slow-disk detection
+(DataNodeMetrics' SlowPeer reports and the ``/stacks`` servlet Hadoop's
+HttpServer2 exposes for hung-daemon triage): a per-daemon background thread
+scans the in-flight table every ``tick_s`` and, when an op has been running
+past its budget, bumps ``stall_total`` on the daemon's registry, captures a
+full thread-stack snapshot into a bounded ring (served by the ``/stacks``
+endpoint), emits a structured log line, and fires the
+``watchdog.stall`` fault-injection point so tests can observe the flag.
+
+Budgets target the environment's two known pathologies (PERF_NOTES.md): the
+~35 s VM write-burst stalls and device dispatches far over the ~100 ms norm.
+Each stalled op is flagged ONCE (re-flagged only if still running after
+another full budget), so a 35 s stall counts as one stall, not 35/tick.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Iterator
+
+from . import fault_injection, log, metrics
+
+DEFAULT_BUDGET_S = float(os.environ.get("HDRF_STALL_BUDGET_S", "30.0"))
+
+
+def thread_stacks() -> dict[str, list[str]]:
+    """Formatted stacks of every live thread (the /stacks servlet body)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: dict[str, list[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        key = f"{names.get(ident, '?')}:{ident}"
+        out[key] = traceback.format_stack(frame)
+    return out
+
+
+class StallWatchdog:
+    """Tracks in-flight ops and flags the ones that exceed their budget."""
+
+    def __init__(self, name: str, budget_s: float = DEFAULT_BUDGET_S,
+                 tick_s: float | None = None,
+                 registry: metrics.MetricsRegistry | None = None) -> None:
+        self.name = name
+        self.budget_s = budget_s
+        self.tick_s = tick_s if tick_s is not None else min(
+            max(budget_s / 4.0, 0.01), 2.0)
+        self._reg = registry if registry is not None else metrics.registry(
+            name)
+        self._log = log.get_logger(f"watchdog.{name}")
+        self._lock = threading.Lock()
+        self._inflight: dict[int, dict[str, Any]] = {}
+        self._next = 0
+        self._stalls: deque[dict[str, Any]] = deque(maxlen=64)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "StallWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name=f"watchdog-{self.name}", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # ------------------------------------------------------------- tracking
+
+    @contextlib.contextmanager
+    def track(self, op: str, budget_s: float | None = None) -> Iterator[None]:
+        """Wrap an operation; the scan thread flags it if it outlives its
+        budget.  Zero-cost beyond one dict insert/remove."""
+        ent = {"op": op, "t0": time.monotonic(),
+               "budget": budget_s if budget_s is not None else self.budget_s,
+               "flagged": 0.0, "thread": threading.get_ident()}
+        with self._lock:
+            self._next += 1
+            key = self._next
+            self._inflight[key] = ent
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            self.scan()
+
+    def scan(self, now: float | None = None) -> int:
+        """One watchdog pass; returns how many ops were newly flagged.
+        Public so tests can drive the check deterministically."""
+        if now is None:
+            now = time.monotonic()
+        stalled: list[dict[str, Any]] = []
+        with self._lock:
+            for ent in self._inflight.values():
+                ref = ent["flagged"] or ent["t0"]
+                if now - ref > ent["budget"]:
+                    ent["flagged"] = now
+                    stalled.append(dict(ent))
+        for ent in stalled:
+            elapsed = now - ent["t0"]
+            self._reg.incr("stall_total")
+            rec = {"ts": time.time(), "daemon": self.name, "op": ent["op"],
+                   "elapsed_s": round(elapsed, 3),
+                   "budget_s": ent["budget"],
+                   "stacks": thread_stacks()}
+            with self._lock:
+                self._stalls.append(rec)
+            self._log.warning("stall", op=ent["op"],
+                              elapsed_s=round(elapsed, 3),
+                              budget_s=ent["budget"])
+            fault_injection.point("watchdog.stall", daemon=self.name,
+                                  op=ent["op"], elapsed_s=elapsed)
+        return len(stalled)
+
+    # ------------------------------------------------------------ introspect
+
+    def inflight(self) -> list[dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            return [{"op": e["op"], "elapsed_s": round(now - e["t0"], 3),
+                     "budget_s": e["budget"], "flagged": bool(e["flagged"])}
+                    for e in self._inflight.values()]
+
+    def stalls(self) -> list[dict[str, Any]]:
+        """Recent stall records, stacks included (newest last)."""
+        with self._lock:
+            return list(self._stalls)
+
+    def stall_count(self) -> int:
+        return self._reg.counter("stall_total")
