@@ -166,9 +166,11 @@ def _fresh_stores(tmp: str, tag: str, on_roll=None):
 
 
 def _cpu_full(blocks: list[np.ndarray], cdc, tmp: str, tag: str):
-    """Single-thread native full path; returns (MB/s, reduction_ratio).
-    The entropy stage runs on each container payload as it rolls over
-    (the on_roll hook — same code path the TPU pass uses)."""
+    """Single-thread native full path; returns (MB/s, reduction_ratio,
+    dedup_ratio) — the last recomputed from the chunk index tables before
+    close, the same ground truth dfsadmin -report aggregates.  The entropy
+    stage runs on each container payload as it rolls over (the on_roll
+    hook — same code path the TPU pass uses)."""
     from hdrf_tpu import native
     from hdrf_tpu.ops.dispatch import gear_mask
 
@@ -195,8 +197,22 @@ def _cpu_full(blocks: list[np.ndarray], cdc, tmp: str, tag: str):
         total += buf.size
     containers.flush_open(on_seal=on_seal)
     dt = time.perf_counter() - t0
+    ist = index.stats()
     index.close()
-    return total / dt / (1 << 20), total / max(state["stored"], 1)
+    from hdrf_tpu.reduction import accounting
+
+    return (total / dt / (1 << 20), total / max(state["stored"], 1),
+            accounting.dedup_ratio(ist["logical_bytes"],
+                                   ist["unique_chunk_bytes"]))
+
+
+def _slow_peer_count() -> int:
+    """Slow peers flagged by the cluster outlier detector — the bench runs
+    no cluster, so this is the detector's verdict over an empty report set
+    (0), keeping the JSON schema identical to the NN's /prom gauge."""
+    from hdrf_tpu.utils import outlier
+
+    return len(outlier.detect({}))
 
 
 def main() -> None:
@@ -224,12 +240,12 @@ def main() -> None:
     try:
         backend = resolve_backend("auto")
         if backend != "tpu":
-            cpu_e2e, cpu_ratio = 0.0, 1.0
+            cpu_e2e, cpu_ratio, cpu_dr = 0.0, 1.0, 1.0
             for i in range(2):
                 os.sync()  # settle writeback between ~0.5 GB passes
-                v, rr = _cpu_full(e2e_hosts, cdc, tmp, f"cpu{i}")
+                v, rr, dr = _cpu_full(e2e_hosts, cdc, tmp, f"cpu{i}")
                 if v > cpu_e2e:
-                    cpu_e2e, cpu_ratio = v, rr
+                    cpu_e2e, cpu_ratio, cpu_dr = v, rr, dr
             led = device_ledger.delta(led0)
             print(json.dumps({
                 "metric": "block reduction pipeline throughput (CDC+SHA-256), "
@@ -238,6 +254,8 @@ def main() -> None:
                 "vs_baseline": 1.0,
                 "e2e_value": round(cpu_e2e, 2), "e2e_vs_baseline": 1.0,
                 "e2e_ratio_cpu": round(cpu_ratio, 3),
+                "dedup_ratio": round(cpu_dr, 4),
+                "slow_peer_count": _slow_peer_count(),
                 "ledger": led,
                 "stalls": led.get("stall_total", 0),
             }))
@@ -299,6 +317,11 @@ def main() -> None:
             if DEBUG:
                 print(f"[{tag}] {label:20s} {time.perf_counter() - t0:7.3f}s",
                       file=sys.stderr)
+
+        # Chunk-index summary of the most recent full pass (captured just
+        # before the pass closes its index): the exact-dedup-ratio source
+        # for the JSON line.
+        idx_summary: dict = {}
 
         def full_pass(tag: str, images: dict | None, hosts: list,
                       dev_parts: list):
@@ -402,6 +425,8 @@ def main() -> None:
                 _finish_group(groups[state["ndone"]])
                 state["ndone"] += 1
             _dbg(tag, "seal_drain", t0)
+            idx_summary.clear()
+            idx_summary.update(index.stats())
             index.close()
             return payloads, state["stored"]
 
@@ -491,8 +516,8 @@ def main() -> None:
                 for leg in legs:
                     os.sync()  # settle writeback debt before each leg
                     if leg == "cpu":
-                        v, cpu_red = _cpu_full(hosts, cdc, tmp,
-                                               f"{label}_cpu{i}")
+                        v, cpu_red, _dr = _cpu_full(hosts, cdc, tmp,
+                                                    f"{label}_cpu{i}")
                         cpu_rates.append(v)
                     else:
                         from hdrf_tpu.utils import device_ledger
@@ -545,6 +570,11 @@ def main() -> None:
             "tg_vs_baseline": round(tg["paired"], 3),
             "tg_ratio_tpu": round(tg["red_tpu"], 3),
             "tg_ratio_cpu": round(tg["red_cpu"], 3),
+            "dedup_ratio": round(
+                idx_summary["logical_bytes"]
+                / max(idx_summary["unique_chunk_bytes"], 1), 4)
+                if idx_summary else 1.0,
+            "slow_peer_count": _slow_peer_count(),
             "ledger": led,
             "stalls": led.get("stall_total", 0),
         }))

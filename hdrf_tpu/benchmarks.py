@@ -3,7 +3,7 @@
 Analogs of the reference's test-tree benchmarks:
 
 - ``nn``   — metadata op throughput against an in-process NameNode
-             (NNThroughputBenchmark.java: single-process, no RPC).
+             (NNThroughputBenchmark.java:97 — single-process, no RPC).
 - ``dfs``  — DFS write/read MB/s through a MiniCluster per reduction scheme
              (BenchmarkThroughput.java).
 - ``ec``   — RS encode/decode MB/s + striped write/read MB/s

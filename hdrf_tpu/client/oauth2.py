@@ -1,7 +1,7 @@
 """OAuth2 access-token providers for the WebHDFS-over-HTTP surface.
 
 Re-expression of the reference's ``web/oauth2`` package —
-``AccessTokenProvider.java`` (the provider abstraction + cache),
+``AccessTokenProvider.java:36`` (the provider abstraction + cache),
 ``ConfCredentialBasedAccessTokenProvider.java`` (client-credentials grant)
 and ``ConfRefreshTokenBasedAccessTokenProvider.java`` (refresh-token grant),
 ``AccessTokenTimer.java`` (expiry tracking with a refresh margin) — over

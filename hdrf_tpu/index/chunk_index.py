@@ -316,6 +316,26 @@ class ChunkIndex:
                 "unique_chunk_bytes": sum(c.length for c in self._chunks.values()),
             }
 
+    def accounting(self) -> dict:
+        """Reduction-effectiveness snapshot over the live tables
+        (reduction/accounting.py's state half): the refcount distribution
+        as a power-of-2 histogram {bucket_upper_bound: chunks} — the
+        sharing profile the reference's missing "Table #3"
+        (DataDeduplicator.java:61-62) would have exposed — plus the exact
+        aggregate the cluster dedup ratio is defined by."""
+        with self._lock:
+            ref_hist: dict[int, int] = {}
+            for c in self._chunks.values():
+                b = 1 << max(c.refcount - 1, 0).bit_length()
+                ref_hist[b] = ref_hist.get(b, 0) + 1
+            return {
+                "refcount_hist": ref_hist,
+                "logical_bytes": sum(b.logical_len
+                                     for b in self._blocks.values()),
+                "unique_chunk_bytes": sum(c.length
+                                          for c in self._chunks.values()),
+            }
+
     # ----------------------------------------------------------- checkpoint
 
     def checkpoint(self) -> None:

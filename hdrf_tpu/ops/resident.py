@@ -4,9 +4,9 @@ The naive composition (ops.gear then ops.sha256) moves the block host->device
 for the CDC scan, back to the host, and *again* to the device as padded SHA
 lane buffers — ~2.2x the block over the wire.  On the PCIe/tunnel path that
 transfer dominates end-to-end throughput (PERF_NOTES.md); the reference has
-the same structural flaw in CPU terms: DataDeduplicator.java re-walks the
-block once per stage (chunking :264-307, then hashing :536-650, then storing
-:652-845) from Java heap buffers.
+the same structural flaw in CPU terms: the reference re-walks the block
+once per stage (chunking DataDeduplicator.java:264-307, then hashing
+:536-650, then storing :652-845) from Java heap buffers.
 
 This pipeline crosses the block to HBM **once** and keeps every per-byte pass
 on device:

@@ -1,6 +1,6 @@
 """Fused Pallas SHA-256 compression kernel.
 
-The XLA lane-parallel scan (ops/sha256.sha256_words) materializes the message
+The XLA lane-parallel scan (ops/sha256.py:93 sha256_words) materializes the message
 schedule per block step and round-trips carry state through HBM between scan
 iterations; measured ~0.8 GB/s on v5e.  This kernel keeps the compression in
 VMEM/registers: the grid walks (lane tiles) x (block chunks), the digest

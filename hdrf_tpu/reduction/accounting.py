@@ -1,0 +1,88 @@
+"""Reduction-effectiveness accounting — the paper's headline metric, kept.
+
+The reference computes its dedup/compression effectiveness offline from the
+Redis tables (SURVEY.md §5); nothing in the running system can answer "how
+much reduction am I getting?".  This module is the online answer: every
+reduction observation point stamps into one ``reduction_accounting``
+registry —
+
+- per-scheme logical vs physical bytes (``logical_bytes__<scheme>`` /
+  ``physical_bytes__<scheme>`` counters), fed by the schemes' reduce paths
+  (reduction/scheme.py, reduction/dedup.py) and the co-located worker's
+  compress ops (server/reduction_worker.py);
+- per-block dedup hit/miss chunk counts (counters ``dedup_chunks_hit`` /
+  ``dedup_chunks_miss`` + per-block histograms), fed by the same commit
+  code dedup_commit / CommitPipeline already run
+  (DataDeduplicator.java:338-367's checkChunk is the hit/miss point);
+- refcount and container-utilization distributions, recomputed fresh from
+  the chunk index's live tables (index/chunk_index.py:309-317's stats
+  surface) by the DataNode's heartbeat assembly — state snapshots, not
+  event streams, so they ride heartbeats as plain dicts.
+
+The cluster dedup ratio is ``sum(logical_len) / sum(unique chunk bytes)``
+over the chunk index — the standard effectiveness metric of the chunking
+literature (arXiv:2505.21194 §V's dedup ratio) and *exactly* recomputable
+from the index tables, which is what the acceptance check pins.
+
+Everything here is host-side counter arithmetic on observation points that
+already exist: zero device dispatches are added (the ledger event count
+for a fixed workload is unchanged — utils/device_ledger.py is never
+touched from this module).
+"""
+
+from __future__ import annotations
+
+from hdrf_tpu.utils import metrics
+
+_ACC = metrics.registry("reduction_accounting")
+
+
+def record_reduce(scheme: str, logical_bytes: int,
+                  physical_bytes: int) -> None:
+    """Per-scheme logical vs physical byte accounting, stamped where a
+    block's reduced form is produced."""
+    _ACC.incr(f"logical_bytes__{scheme}", int(logical_bytes))
+    _ACC.incr(f"physical_bytes__{scheme}", int(physical_bytes))
+
+
+def record_dedup_block(chunks: int, new_chunks: int) -> None:
+    """Per-block dedup hit/miss chunk accounting (a hit = a chunk whose
+    fingerprint was already indexed; a miss appended new container
+    bytes)."""
+    hits = int(chunks) - int(new_chunks)
+    _ACC.incr("dedup_chunks_hit", hits)
+    _ACC.incr("dedup_chunks_miss", int(new_chunks))
+    _ACC.observe("block_hit_chunks", hits)
+    _ACC.observe("block_miss_chunks", int(new_chunks))
+
+
+def record_worker_bytes(op: str, nbytes: int) -> None:
+    """Reduction-worker stamp: bytes processed per worker op family."""
+    _ACC.incr(f"worker_{op}_bytes", int(nbytes))
+
+
+def snapshot() -> dict:
+    """The registry snapshot (rides DN heartbeats; also on /prom and
+    /metrics through the process-wide exposition)."""
+    return _ACC.snapshot()
+
+
+def dedup_ratio(logical_bytes: int, unique_chunk_bytes: int) -> float:
+    """logical / unique-chunk bytes, 1.0 for an empty index — the exact
+    ground-truth ratio the chunk index defines."""
+    return (logical_bytes / unique_chunk_bytes) if unique_chunk_bytes else 1.0
+
+
+def utilization_hist(live_bytes: dict, sizes: dict) -> dict:
+    """Container-utilization decile histogram: live referenced bytes over
+    bytes on disk, per container.  Sealed (compressed) containers can
+    exceed 1.0 — that is the compression win showing up; dead weight
+    (orphaned/dereferenced chunks) shows up as low deciles, the
+    compaction-planning signal.  Buckets: 0..9 = [i/10, (i+1)/10), 10 =
+    >= 1.0."""
+    out: dict[int, int] = {}
+    for cid, sz in sizes.items():
+        u = (live_bytes.get(cid, 0) / sz) if sz else 0.0
+        b = min(int(u * 10), 10)
+        out[b] = out.get(b, 0) + 1
+    return out

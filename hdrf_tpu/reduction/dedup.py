@@ -34,7 +34,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from hdrf_tpu.ops import dispatch
-from hdrf_tpu.reduction import scheme as scheme_mod
+from hdrf_tpu.reduction import accounting, scheme as scheme_mod
 from hdrf_tpu.reduction.scheme import ReductionContext, ReductionScheme
 from hdrf_tpu.utils import metrics, tracing
 
@@ -88,7 +88,7 @@ def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
     (DataDeduplicator.java checkChunk :338-367 + storeChunksMT :511-532 +
     storeDB :372-392).  Shared by DedupScheme.reduce and the full-path
     benchmark so the timed path IS the product path.  Returns
-    (chunk_count, new_unique_count)."""
+    (chunk_count, new_unique_count, new_unique_bytes)."""
     mv, hashes, first_range = _block_prep(data, cuts, digests)
     n = len(cuts)
     if index.get_block(block_id) is not None:
@@ -105,8 +105,10 @@ def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
                        dict(zip(new_hashes, locs)))
     _M.incr("chunks_total", n)
     _M.incr("chunks_new", len(new_hashes))
-    _M.incr("bytes_new", sum(ln for _, _, ln in locs))
-    return n, len(new_hashes)
+    new_bytes = sum(ln for _, _, ln in locs)
+    _M.incr("bytes_new", new_bytes)
+    accounting.record_dedup_block(n, len(new_hashes))
+    return n, len(new_hashes), new_bytes
 
 
 class CommitPipeline:
@@ -184,6 +186,7 @@ class CommitPipeline:
                 recs.append((block_id, len(data), hashes, new))
                 _M.incr("chunks_total", len(hashes))
                 _M.incr("chunks_new", len(new_hashes))
+                accounting.record_dedup_block(len(hashes), len(new_hashes))
             self._containers.sync_lanes()  # bytes at least as durable as
             # the store's policy allows BEFORE the index references them
             self._index.commit_blocks(recs)
@@ -222,12 +225,13 @@ class DedupScheme(ReductionScheme):
                 buf = np.frombuffer(data, dtype=np.uint8)
                 cuts, digests = dispatch.chunk_and_fingerprint(
                     buf, ctx.config.cdc, ctx.backend)
-            n, new = dedup_commit(block_id, data, cuts, digests,
-                                  ctx.index, ctx.containers)
+            n, new, new_bytes = dedup_commit(block_id, data, cuts, digests,
+                                             ctx.index, ctx.containers)
             sp.annotate("chunks", n)
             sp.annotate("unique_new", new)
             _M.incr("blocks_reduced")
             _M.incr("bytes_logical", len(data))
+            accounting.record_reduce(self.name, len(data), new_bytes)
         return b""  # replica data file stays empty by design
 
     def reduce_with(self, block_id: int, data: bytes, cuts, digests,
@@ -236,10 +240,11 @@ class DedupScheme(ReductionScheme):
         path: the DN already forwarded the packet stream to the worker and
         holds (cuts, digests)."""
         assert ctx.index is not None and ctx.containers is not None
-        dedup_commit(block_id, data, cuts, digests, ctx.index,
-                     ctx.containers)
+        _, _, new_bytes = dedup_commit(block_id, data, cuts, digests,
+                                       ctx.index, ctx.containers)
         _M.incr("blocks_reduced")
         _M.incr("bytes_logical", len(data))
+        accounting.record_reduce(self.name, len(data), new_bytes)
         return b""
 
     # ---------------------------------------------------------------- read

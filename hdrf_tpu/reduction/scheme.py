@@ -28,6 +28,7 @@ from abc import ABC, abstractmethod
 from dataclasses import dataclass
 from typing import TYPE_CHECKING
 
+from hdrf_tpu.reduction import accounting
 from hdrf_tpu.utils import codec as codecs
 
 if TYPE_CHECKING:
@@ -111,6 +112,7 @@ class DirectScheme(ReductionScheme):
     name = "direct"
 
     def reduce(self, block_id: int, data: bytes, ctx: ReductionContext) -> bytes:
+        accounting.record_reduce(self.name, len(data), len(data))
         return data
 
     def reconstruct(self, block_id: int, stored: bytes, logical_len: int,
@@ -133,14 +135,18 @@ class CompressScheme(ReductionScheme):
     def reduce(self, block_id: int, data: bytes, ctx: ReductionContext) -> bytes:
         from hdrf_tpu.ops import dispatch
 
+        out = None
         if ctx.worker is not None:
             from hdrf_tpu.server.reduction_worker import WorkerError
 
             try:
-                return ctx.worker.compress(self._codec, data)
+                out = ctx.worker.compress(self._codec, data)
             except WorkerError:
                 pass  # dead worker: host codec below
-        return dispatch.block_compress(self._codec, data, ctx.backend)
+        if out is None:
+            out = dispatch.block_compress(self._codec, data, ctx.backend)
+        accounting.record_reduce(self.name, len(data), len(out))
+        return out
 
     def reconstruct(self, block_id: int, stored: bytes, logical_len: int,
                     ctx: ReductionContext, offset: int = 0,

@@ -2,7 +2,8 @@
 
 The reference gates DataNode ops with HMAC'd block tokens minted by the
 NameNode and verified by DataNodes sharing a rolling secret
-(`security/token/block/BlockTokenSecretManager`).  Same scheme here:
+(security/token/block/BlockTokenSecretManager.java:112).  Same scheme
+here:
 
 - the NN keeps a current + previous key (rolled every ``roll_interval_s``;
   verification accepts both, so a roll never invalidates in-flight tokens);

@@ -110,7 +110,8 @@ class BlockReceiver:
                 for seqno, data, flags in dt.iter_packets_ex(sock):
                     last = bool(flags & dt.FLAG_LAST)
                     fault_injection.point("block_receiver.packet",
-                                          block_id=block_id, seqno=seqno)
+                                          block_id=block_id, seqno=seqno,
+                                          dn_id=dn.dn_id)
                     if mirror_sock is not None:
                         _mt0 = time.perf_counter()
                         dt.write_packet(mirror_sock, seqno, data,

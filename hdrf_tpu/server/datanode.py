@@ -41,7 +41,9 @@ from hdrf_tpu.reduction.scheme import ReductionContext, ReductionScheme
 from hdrf_tpu.server.block_receiver import BlockReceiver
 from hdrf_tpu.server.block_sender import BlockSender
 from hdrf_tpu.server.status_http import StatusHttpServer
-from hdrf_tpu.utils import device_ledger, fault_injection, metrics, tracing
+from hdrf_tpu.reduction import accounting
+from hdrf_tpu.utils import (device_ledger, fault_injection, log, metrics,
+                            rollwin, tracing)
 from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("datanode")
@@ -243,10 +245,13 @@ class DataNode:
         self._threads: list[threading.Thread] = []
         self._ibr_queue: list[tuple[int, int, int, str | None]] = []
         self._ibr_event = threading.Event()
-        # Slow-peer detection inputs (DataNodePeerMetrics analog): rolling
-        # window of normalized downstream-transfer latencies per peer.
-        self._peer_lat: dict[str, list[float]] = {}
-        self._peer_lat_lock = threading.Lock()
+        # Slow-peer detection inputs (DataNodePeerMetrics analog): decayed
+        # rolling window of normalized downstream-transfer latencies per
+        # peer, plus the same shape per volume over disk-probe durations
+        # (DataNodeVolumeMetrics analog).  Both ride heartbeats to the NN.
+        self._peer_win = rollwin.WindowMap(window_s=300.0, maxlen=64)
+        self._vol_win = rollwin.WindowMap(window_s=300.0, maxlen=64)
+        self._log = log.get_logger("datanode")
         import time as _time
         # lifeline trigger clocks, PER NN (the reference's lifeline is
         # per-BPServiceActor): a heartbeat landing at one NN must not
@@ -346,6 +351,10 @@ class DataNode:
                                   daemon=True)
             lw.start()
             self._threads.append(lw)
+        self._log.info("datanode started", dn_id=self.dn_id,
+                       addr=f"{self.addr[0]}:{self.addr[1]}",
+                       volumes=len(self.volumes.volumes),
+                       backend=self.reduction_ctx.backend)
         return self
 
     def _lazy_writer_loop(self) -> None:
@@ -651,6 +660,8 @@ class DataNode:
                 ok += 1
             except (OSError, ConnectionError):
                 _M.incr("register_failures")
+                self._log.warning("namenode registration failed",
+                                  dn_id=self.dn_id, namenode=c.addr)
         if ok == 0 and nn is None:
             raise ConnectionError("no namenode reachable at registration")
 
@@ -729,23 +740,64 @@ class DataNode:
                     _M.incr("lifeline_failures")
 
     def note_peer_latency(self, dn_id: str, s_per_mb: float) -> None:
-        with self._peer_lat_lock:
-            w = self._peer_lat.setdefault(dn_id, [])
-            w.append(s_per_mb)
-            del w[:-64]  # rolling window
+        self._peer_win.note(dn_id, s_per_mb)
+
+    def note_volume_latency(self, vol_id: int, seconds: float) -> None:
+        """Disk-probe / IO duration sample for slow-volume detection
+        (DataNodeVolumeMetrics feeding SlowDiskTracker)."""
+        self._vol_win.note(int(vol_id), seconds)
 
     def _peer_report(self) -> dict:
         """dn_id -> (median s/MB, samples) — rides heartbeats to the NN
         (SlowPeerReports analog)."""
-        import statistics
+        return {d: [s["median"], s["count"]]
+                for d, s in self._peer_win.summaries().items()}
 
-        with self._peer_lat_lock:
-            return {d: [statistics.median(w), len(w)]
-                    for d, w in self._peer_lat.items() if w}
+    def _volume_report(self) -> dict:
+        """vol_id -> health + IO summary, riding heartbeats (the
+        VolumeFailureSummary + SlowDiskReports payload, folded into one)."""
+        probes = self._vol_win.summaries()
+        out = {}
+        for v in self.volumes.volumes:
+            p = probes.get(v.vol_id)
+            out[str(v.vol_id)] = {
+                "storage_type": v.storage_type,
+                "failed": v.failed,
+                "used_bytes": 0 if v.failed else v.used_bytes(),
+                "probe_median_s": p["median"] if p else None,
+                "probe_count": p["count"] if p else 0,
+            }
+        return out
+
+    def _reduction_report(self) -> dict:
+        """Per-DN reduction-effectiveness aggregate: chunk-index truth
+        (logical vs unique bytes, refcount histogram), container
+        utilization deciles, and the process accounting counters.  Pure
+        host-side table reads — no device work."""
+        acc = self.index.accounting()
+        live = self.index.container_live_bytes()
+        sizes = {}
+        if not self.config.simulated_dataset:
+            try:
+                sizes = self.containers.container_sizes()
+            except OSError:
+                pass
+        return {
+            "logical_bytes": acc["logical_bytes"],
+            "unique_chunk_bytes": acc["unique_chunk_bytes"],
+            "dedup_ratio": accounting.dedup_ratio(
+                acc["logical_bytes"], acc["unique_chunk_bytes"]),
+            "refcount_hist": acc["refcount_hist"],
+            "container_util_hist": accounting.utilization_hist(live, sizes),
+            "counters": accounting.snapshot(),
+        }
 
     def _stats(self) -> dict:
         return {
             "peer_transfer": self._peer_report(),
+            "volumes": self._volume_report(),
+            "reduction": self._reduction_report(),
+            "stalls": self.watchdog.stall_count(),
             "blocks": len(self.replicas.block_ids()),
             "logical_bytes": sum(m[2] for m in self.replicas.block_report()),
             "physical_bytes": (self.replicas.physical_bytes()
@@ -1060,6 +1112,8 @@ class DataNode:
         push an immediate block report so the NN learns the lost replicas
         NOW (not at the next periodic report) and re-replicates."""
         lost = self.volumes.eject(vol_id)
+        self._log.warning("volume ejected", dn_id=self.dn_id, vol_id=vol_id,
+                          lost_replicas=len(lost))
         if lost:
             try:
                 self._send_block_report()
@@ -1072,12 +1126,21 @@ class DataNode:
         EJECTED (blocks re-replicate from peers, the DN keeps serving the
         rest); the DN exits only when the last volume has failed — the
         reference's failed.volumes.tolerated behavior."""
+        import time as _time
+
         fails = {v.vol_id: 0 for v in self.volumes.volumes}
         while not self._stop.wait(self.config.volume_check_interval_s):
             for v in self.volumes.volumes:
                 if v.failed:
                     continue
-                if self.check_volume(v.root):
+                t0 = _time.perf_counter()
+                ok = self.check_volume(v.root)
+                if ok:
+                    # probe duration feeds slow-volume detection: a disk
+                    # that still answers but slowly is exactly what the
+                    # 3-strikes ejection below can never see
+                    self.note_volume_latency(v.vol_id,
+                                             _time.perf_counter() - t0)
                     fails[v.vol_id] = 0
                     _M.incr("volume_checks_ok")
                     continue
@@ -1109,6 +1172,8 @@ class DataNode:
                 bad = self.verify_block(bid)
                 if bad:
                     _M.incr("scanner_corrupt_found")
+                    self._log.warning("scanner found corrupt replica",
+                                      dn_id=self.dn_id, block_id=bid)
                     for nn in self._nns:
                         try:
                             nn.call("bad_block", dn_id=self.dn_id,

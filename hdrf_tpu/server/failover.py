@@ -1,7 +1,8 @@
 """Automatic failover controller (the ZKFC analog, minus ZooKeeper).
 
 The reference's DFSZKFailoverController watches NN health via RPC and uses a
-ZooKeeper leader lock to coordinate who promotes whom (HAZKInfo.proto).  Here
+ZooKeeper leader lock to coordinate who promotes whom
+(DFSZKFailoverController.java:63; HAZKInfo.proto).  Here
 the shared journal's epoch IS the lock (editlog.claim_epoch fences the old
 writer), so the controller only needs health checking + a promote call:
 poll every NN's ha_state; if no active answers for ``grace`` consecutive

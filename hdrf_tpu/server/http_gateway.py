@@ -45,9 +45,10 @@ from urllib.parse import parse_qs, quote, unquote, urlparse
 import msgpack
 
 from hdrf_tpu.client.filesystem import HdrfClient
-from hdrf_tpu.utils import device_ledger, metrics, prom, tracing
+from hdrf_tpu.utils import device_ledger, log, metrics, prom, tracing
 
 _M = metrics.registry("http_gateway")
+_LOG = log.get_logger("http_gateway")
 PREFIX = "/webhdfs/v1"
 
 
@@ -134,6 +135,8 @@ class HttpGateway:
                         return self._html(gateway.journal_page())
                     if u.path == "/status":
                         return self._json(200, gateway.status())
+                    if u.path == "/health":
+                        return self._json(200, gateway.health())
                     if u.path == "/metrics":
                         return self._json(200, gateway.metrics())
                     if u.path == "/prom":
@@ -413,6 +416,8 @@ class HttpGateway:
 
     def start(self) -> "HttpGateway":
         self._thread.start()
+        _LOG.info("http gateway started",
+                  addr=f"{self.addr[0]}:{self.addr[1]}")
         return self
 
     def stop(self) -> None:
@@ -422,9 +427,43 @@ class HttpGateway:
     def status(self) -> dict:
         with HdrfClient(self._nn_addr, name="http-gw") as c:
             report = c.datanode_report()
+            cluster = c._call("cluster_status")
         return {"datanodes": report,
                 "live": sum(1 for d in report if d["alive"]),
-                "dead": sum(1 for d in report if not d["alive"])}
+                "dead": sum(1 for d in report if not d["alive"]),
+                "dedup_ratio": cluster.get("dedup_ratio"),
+                "slow_peers": cluster.get("slow_peers"),
+                "slow_volumes": cluster.get("slow_volumes")}
+
+    def health(self) -> dict:
+        """Cluster health verdict for load balancers / dashboards: DN
+        liveness buckets, safemode, the outlier detector's slow-peer /
+        slow-volume flags (slow_nodes_report RPC) and the cluster-wide
+        reduction effectiveness — one JSON fetch, no namespace access
+        required (the dfshealth JMX-scrape replacement)."""
+        try:
+            with HdrfClient(self._nn_addr, name="http-gw") as c:
+                cluster = c._call("cluster_status")
+                slow = c._call("slow_nodes_report")
+        except (OSError, ConnectionError):
+            _M.incr("health_nn_unreachable")
+            _LOG.warning("health probe: namenode unreachable",
+                         namenode=str(self._nn_addr))
+            return {"status": "unreachable", "namenode": str(self._nn_addr)}
+        degraded = (cluster["dead"] > 0 or cluster["safemode"]
+                    or cluster["under_replicated"] > 0
+                    or slow["slow_peers"] or slow["slow_volumes"])
+        return {"status": "degraded" if degraded else "healthy",
+                "role": cluster["role"],
+                "safemode": cluster["safemode"],
+                "live": cluster["live"], "dead": cluster["dead"],
+                "blocks": cluster["blocks"],
+                "under_replicated": cluster["under_replicated"],
+                "slow_peers": slow["slow_peers"],
+                "slow_volumes": slow["slow_volumes"],
+                "dedup_ratio": cluster["dedup_ratio"],
+                "dedup_logical_bytes": cluster["dedup_logical_bytes"],
+                "dedup_unique_bytes": cluster["dedup_unique_bytes"]}
 
     def metrics(self) -> dict:
         with HdrfClient(self._nn_addr, name="http-gw") as c:

@@ -6,8 +6,8 @@ Two interchangeable transports behind ``EditLog`` (server/editlog.py):
   epoch-fenced appends (the NFS-shared-edits deployment; what round 1
   shipped).
 - ``QuorumJournal`` + ``JournalNode`` — the re-expression of the reference's
-  quorum journal (``qjournal/client/QuorumJournalManager.java`` and
-  ``qjournal/server/JournalNode.java``, ~6.1 kLoC): N journal daemons, every
+  quorum journal (qjournal/client/QuorumJournalManager.java:55 and
+  qjournal/server/JournalNode.java:61, ~6.1 kLoC): N journal daemons, every
   edit batch is durable once a MAJORITY acks, epochs fence stale writers at
   each journal node, and becoming active runs segment recovery (promise
   collection, longest-retained-log selection, re-journaling the tail to

@@ -34,7 +34,7 @@ from hdrf_tpu.proto.rpc import RpcError, RpcServer
 from hdrf_tpu.server import permissions as perm
 from hdrf_tpu.server.editlog import EditLog
 from hdrf_tpu.server.permissions import Attrs, DirNode
-from hdrf_tpu.utils import fault_injection, metrics, tracing
+from hdrf_tpu.utils import fault_injection, log, metrics, outlier, tracing
 from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("namenode")
@@ -368,6 +368,7 @@ class NameNode:
                                             watchdog=self.watchdog)
         self._monitor_stop = threading.Event()
         self._monitor: threading.Thread | None = None
+        self._logger = log.get_logger("namenode")
 
     # ------------------------------------------------------------ lifecycle
 
@@ -381,6 +382,9 @@ class NameNode:
         self._monitor = threading.Thread(target=target, name="nn-monitor",
                                          daemon=True)
         self._monitor.start()
+        self._logger.info("namenode started", role=self.role,
+                       addr=f"{self.addr[0]}:{self.addr[1]}",
+                       blocks=len(self._blocks))
         return self
 
     def stop(self) -> None:
@@ -2411,6 +2415,8 @@ class NameNode:
                 sc_path=sc_path, rack=rack, storage_type=storage_type,
                 storage_types=tuple(storage_types or [storage_type]))
             _M.incr("dn_registered")
+            self._logger.info("datanode registered", dn_id=dn_id,
+                           addr=f"{addr[0]}:{addr[1]}", rack=rack)
             keys = None
             if self._tokens is not None:
                 # keys ship WITH registration (the reference's
@@ -2448,6 +2454,10 @@ class NameNode:
             dn.stats = stats or {}
             if "cached_blocks" in dn.stats:
                 dn.cached = set(dn.stats["cached_blocks"])
+            # refresh health intelligence on every stats delivery so the
+            # slow-peer/slow-volume gauges are never older than one
+            # heartbeat interval (SlowPeerTracker's report-driven update)
+            self._health_report()
             keys = None
             if self._tokens is not None:
                 self._tokens.maybe_roll()
@@ -2690,6 +2700,7 @@ class NameNode:
             now = time.monotonic()
             live = dead = decom = 0
             logical = physical = cached = 0
+            ded_logical = ded_unique = 0
             for d in self._datanodes.values():
                 alive = (now - d.last_heartbeat
                          < self.config.dead_node_interval_s)
@@ -2703,11 +2714,17 @@ class NameNode:
                 logical += int(st.get("logical_bytes", 0))
                 physical += int(st.get("physical_bytes", 0))
                 cached += int(st.get("cache_used", 0))
+                idx = st.get("index") or {}
+                ded_logical += int(idx.get("logical_bytes", 0))
+                ded_unique += int(idx.get("unique_chunk_bytes", 0))
             # The under-replicated count is the redundancy monitor's own
             # (cached each _check_replication tick) — recomputing it here
             # would both duplicate the want/counted semantics and walk
             # every block under the namesystem lock per page load.
             under = self._under_replicated
+            health = self._health_report()
+            from hdrf_tpu.reduction import accounting as _acc
+
             return {
                 "role": self.role,
                 "safemode": self._in_safemode(),
@@ -2717,6 +2734,14 @@ class NameNode:
                 "live": live, "dead": dead, "decommissioning": decom,
                 "logical_bytes": logical, "physical_bytes": physical,
                 "cache_used": cached,
+                # cluster-wide reduction effectiveness: the chunk-index
+                # aggregates every DN ships in its heartbeat, summed —
+                # exactly the recompute-from-index ground truth
+                "dedup_logical_bytes": ded_logical,
+                "dedup_unique_bytes": ded_unique,
+                "dedup_ratio": _acc.dedup_ratio(ded_logical, ded_unique),
+                "slow_peers": len(health["slow_peers"]),
+                "slow_volumes": len(health["slow_volumes"]),
                 "editlog_seq": self._editlog.seq,
                 "journal_addrs": [list(a) for a in
                                   (self.config.journal_addrs or [])],
@@ -2761,6 +2786,8 @@ class NameNode:
                 dn.blocks.discard(block_id)
             self._pending_repl.pop(block_id, None)  # reschedule immediately
             _M.incr("corrupt_replicas_reported")
+            self._logger.warning("corrupt replica reported", dn_id=dn_id,
+                              block_id=block_id)
             return True
 
     def rpc_datanode_blocks(self, dn_id: str, limit: int = 100) -> list[int]:
@@ -2847,6 +2874,50 @@ class NameNode:
     # what the rest of the cluster looks like (the reference's low-threshold
     # guard, OutlierDetector.lowThresholdMs, inverted to a floor).
     SLOW_PEER_FLOOR_S_PER_MB = 1.0
+    # Same idea for disk probes: one write+read+unlink of a few bytes
+    # taking a full second is a sick disk on any hardware.
+    SLOW_VOLUME_FLOOR_S = 1.0
+
+    def _health_report(self) -> dict:
+        """Cluster health intelligence over the DN heartbeat telemetry
+        (caller holds self._lock): per-peer pipeline-latency medians and
+        per-volume disk-probe medians through the median+MAD outlier
+        detector (utils/outlier.py — OutlierDetector.java:61-103 applied
+        to both SlowPeerTracker and SlowDiskTracker populations), with
+        the absolute floors covering tiny-population clusters where the
+        MAD rule has no baseline.  Updates the /prom gauges as a side
+        effect so exposition is never older than one heartbeat."""
+        import statistics
+
+        peers: dict[str, list[float]] = {}
+        vols: dict[str, float] = {}
+        for dn in self._datanodes.values():
+            st = dn.stats or {}
+            for peer, rep in (st.get("peer_transfer") or {}).items():
+                peers.setdefault(peer, []).append(float(rep[0]))
+            for vid, v in (st.get("volumes") or {}).items():
+                pm = v.get("probe_median_s")
+                if pm is not None and not v.get("failed"):
+                    vols[f"{dn.dn_id}:vol-{vid}"] = float(pm)
+        peer_meds = {p: statistics.median(ms) for p, ms in peers.items()}
+        slow_peers = outlier.detect(
+            peer_meds, abs_floor=self.SLOW_PEER_FLOOR_S_PER_MB)
+        slow_vols = outlier.detect(
+            vols, abs_floor=self.SLOW_VOLUME_FLOOR_S)
+        _M.gauge("slow_peer_count", len(slow_peers))
+        _M.gauge("slow_volume_count", len(slow_vols))
+        return {"slow_peers": slow_peers,
+                "slow_volumes": slow_vols,
+                "peer_medians_s_per_mb": peer_meds,
+                "volume_probe_medians_s": vols,
+                "reporters": {p: len(ms) for p, ms in peers.items()}}
+
+    def rpc_slow_nodes_report(self) -> dict:
+        """Health-intelligence RPC backing ``dfsadmin -slowPeers`` and the
+        gateway's /health endpoint: the outlier detector's verdict over the
+        latest heartbeat telemetry, plus the raw medians it judged."""
+        with self._lock:
+            return self._health_report()
 
     def rpc_slow_peers(self) -> dict:
         """SlowPeerTracker.java:56 analog: aggregate the DNs' peer-latency

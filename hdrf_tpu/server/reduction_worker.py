@@ -36,6 +36,7 @@ import numpy as np
 from hdrf_tpu.config import CdcConfig
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import recv_frame, send_frame
+from hdrf_tpu.reduction import accounting
 from hdrf_tpu.utils import metrics, tracing
 
 _M = metrics.registry("reduction_worker")
@@ -167,12 +168,14 @@ class ReductionWorker:
             buf = np.frombuffer(data, dtype=np.uint8)
             cuts, digs = ops_dispatch.chunk_and_fingerprint(
                 buf, cdc, self.backend)
+        nbytes = int(cuts[-1]) if len(cuts) else 0
         with self._stats_lock:
             self._stats["blocks_reduced"] += 1
-            self._stats["bytes_reduced"] += int(cuts[-1]) if len(cuts) else 0
+            self._stats["bytes_reduced"] += nbytes
         send_frame(sock, {"cuts": np.asarray(cuts, np.int64).tobytes(),
                           "digests": np.ascontiguousarray(digs).tobytes()})
         _M.incr("blocks_reduced")
+        accounting.record_worker_bytes("reduce", nbytes)
 
     def _reduce_streaming_tpu(self, sock: socket.socket, cdc: CdcConfig):
         import jax
@@ -218,6 +221,7 @@ class ReductionWorker:
             self._stats["compress_jobs"] += 1
         send_frame(sock, {"data": bytes(out)})
         _M.incr("compress_jobs")
+        accounting.record_worker_bytes("compress", len(data))
 
     def _op_compress_batch(self, sock: socket.socket, req: dict) -> None:
         """N payloads in one round trip (a DN sealing several container
@@ -245,6 +249,7 @@ class ReductionWorker:
             self._stats["compress_jobs"] += len(sizes)
         send_frame(sock, {"datas": [bytes(o) for o in outs]})
         _M.incr("compress_jobs", len(sizes))
+        accounting.record_worker_bytes("compress", len(blob))
 
 
 # ------------------------------------------------------------------ client

@@ -2,7 +2,7 @@
 
 Equivalent of the reference's per-daemon HttpServer2 servlet set (every
 Hadoop daemon serves /jmx, /metrics, /stacks and /conf on its info port;
-DataNode.java wires it at startup): a tiny threaded HTTP server each daemon
+DataNode.java:499 wires it at startup): a tiny threaded HTTP server each daemon
 opts into via ``status_port`` config, serving
 
 - ``/prom``    — Prometheus text exposition over this process's registries

@@ -477,3 +477,18 @@ class ContainerStore:
             if name.endswith(".raw") or name.endswith(".sealed"):
                 total += os.path.getsize(os.path.join(self._dir, name))
         return total
+
+    def container_sizes(self) -> dict[int, int]:
+        """cid -> bytes on disk (raw + sealed forms summed) — the
+        denominator of the utilization accounting
+        (reduction/accounting.py:utilization_hist).  stat() calls only;
+        never opens the files."""
+        out: dict[int, int] = {}
+        for name in os.listdir(self._dir):
+            stem = name.split(".")[0]
+            if stem.isdigit() and (name.endswith(".raw")
+                                   or name.endswith(".sealed")):
+                cid = int(stem)
+                out[cid] = out.get(cid, 0) + os.path.getsize(
+                    os.path.join(self._dir, name))
+        return out

@@ -4,8 +4,8 @@ Equivalent of the reference's ``FsDatasetImpl.java`` (replica files, RBW ->
 finalized lifecycle, `FsDatasetImpl.finalizeBlock`) — but designed so reduced
 blocks need **no shadow-length patches**.  The reference leaves the replica
 file at 0 bytes when a block is reduced and patches ~12 length/consistency
-checks across HDFS to tolerate it (SURVEY.md §2.3: `FsDatasetImpl.getLength`
-Redis probe :735-761, `DirectoryScanner` check disabled :437-438,
+checks across HDFS to tolerate it (SURVEY.md §2.3: the getLength Redis
+probe FsDatasetImpl.java:735-761, `DirectoryScanner` check disabled :437-438,
 `Replica.setNumBytes` spoofing, ...).
 
 Here every replica carries a sidecar ``BlockMeta`` record from creation:

@@ -490,6 +490,12 @@ class MultiContainerStore:
     def physical_bytes(self) -> int:
         return sum(v.containers.physical_bytes() for v in self._vs._alive())
 
+    def container_sizes(self) -> dict[int, int]:
+        out: dict[int, int] = {}
+        for v in self._vs._alive():
+            out.update(v.containers.container_sizes())
+        return out
+
     @property
     def _on_delete(self):
         return self._vs.volumes[0].containers._on_delete

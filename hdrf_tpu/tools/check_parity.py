@@ -1,0 +1,66 @@
+"""Parity-citation lint: every module must cite its reference sources.
+
+The repo convention (CLAUDE.md; e.g. the headers of server/datanode.py,
+reduction/dedup.py) is that each module's docstring names the reference
+files it re-expresses with ``file:line`` citations — DataNode.java:438,
+SlowPeerTracker.java:56, index/chunk_index.py:309 — so the component map
+(PARITY.md) stays verifiable against the code.  This tool enforces it:
+every ``hdrf_tpu/**/*.py`` module (``__init__.py`` exempt — package
+markers carry no component of their own) must have a docstring containing
+at least one such citation.
+
+Run as ``python -m hdrf_tpu.tools.check_parity`` (exit 1 on violations);
+wired as a tier-1 test in tests/test_tools.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+# file.ext:NNN with an optional -NNN range, e.g. "OutlierDetector.java:61-103"
+CITATION = re.compile(
+    r"[A-Za-z0-9_][A-Za-z0-9_.\-/]*"
+    r"\.(?:java|py|c|cc|cpp|h|hpp|proto|md|html|sh|json)"
+    r":\d+(?:-\d+)?")
+
+
+def check(root: str) -> list[str]:
+    """Return one message per violating module (empty = clean)."""
+    problems: list[str] = []
+    for dirpath, _dirs, files in sorted(os.walk(root)):
+        for fn in sorted(files):
+            if not fn.endswith(".py") or fn == "__init__.py":
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(root))
+            try:
+                tree = ast.parse(open(path, encoding="utf-8").read(), path)
+            except SyntaxError as e:
+                problems.append(f"{rel}: unparseable ({e.msg})")
+                continue
+            doc = ast.get_docstring(tree)
+            if not doc:
+                problems.append(f"{rel}: no module docstring")
+            elif not CITATION.search(doc):
+                problems.append(f"{rel}: docstring has no file:line "
+                                f"reference citation")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    problems = check(root)
+    for p in problems:
+        print(p)
+    print(f"{len(problems)} violation(s)" if problems
+          else "parity citations: all modules cite references")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

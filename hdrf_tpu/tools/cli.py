@@ -2,7 +2,7 @@
 
 One entry point (``python -m hdrf_tpu.tools.cli``) with subcommands mirroring
 the reference's launcher + admin tools (``src/main/bin/hdfs`` subcommand
-dispatch; DFSAdmin, OfflineImageViewer / OfflineEditsViewer under
+dispatch; DFSAdmin.java:441, OfflineImageViewer / OfflineEditsViewer under
 ``hdfs/tools/``; Balancer under ``server/balancer/``):
 
   namenode / datanode      daemon launchers
@@ -247,19 +247,40 @@ def cmd_dfsadmin(args) -> int:
         return 0
     with _client(args) as c:
         if args.op == "-report":
+            # cluster summary first (dfsadmin -report's header block).
+            # dedup_ratio prints with repr fidelity: operators (and the
+            # acceptance test) compare it exactly against the ratio
+            # recomputed from the chunk index.
+            cs = c._call("cluster_status")
+            print(f"Cluster: up={cs['live']} down={cs['dead']} "
+                  f"blocks={cs['blocks']} "
+                  f"under_replicated={cs['under_replicated']} "
+                  f"safemode={cs['safemode']}")
+            print(f"Reduction: dedup_ratio={cs['dedup_ratio']!r} "
+                  f"dedup_logical={cs['dedup_logical_bytes']} "
+                  f"dedup_unique={cs['dedup_unique_bytes']}")
+            print(f"Health: slow_peers={cs['slow_peers']} "
+                  f"slow_volumes={cs['slow_volumes']}")
             for d in c.datanode_report():
                 state = "live" if d["alive"] else "dead"
                 stats = d.get("stats", {})
+                stalls = stats.get("stalls", 0)
+                vols = stats.get("volumes") or {}
+                failed = sum(1 for v in vols.values() if v.get("failed"))
                 print(f"{d['dn_id']:>12} {state:>5} blocks={d['blocks']} "
                       f"logical={stats.get('logical_bytes', 0)} "
-                      f"physical={stats.get('physical_bytes', 0)}")
+                      f"physical={stats.get('physical_bytes', 0)} "
+                      f"volumes={len(vols)} failed_volumes={failed} "
+                      f"stalls={stalls}")
         elif args.op == "-savenamespace":
             c._call("save_namespace")
             print("namespace saved")
         elif args.op == "-metrics":
             print(json.dumps(c._call("metrics"), indent=2, sort_keys=True))
         elif args.op == "-slowPeers":
-            print(json.dumps(c._call("slow_peers"), indent=2))
+            # the outlier detector's verdict (slow_nodes_report) — peers
+            # AND volumes, with the medians they were judged against
+            print(json.dumps(c._call("slow_nodes_report"), indent=2))
         elif args.op == "-finalizeUpgrade":
             r = c._call("finalize_upgrade")
             print(f"finalized: namenode={r['namenode_finalized']} "

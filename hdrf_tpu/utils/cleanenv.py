@@ -4,8 +4,9 @@ The dev tunnel's sitecustomize force-registers its TPU backend whenever
 ``PALLAS_AXON_POOL_IPS`` is present, and platform selection only takes
 effect via process env at interpreter start — so any code that needs a
 true n-device XLA:CPU mesh (tests/conftest.py, __graft_entry__'s dryrun)
-must re-exec a child with the env built here.  Keeping the recipe in one
-place means a future tunnel change is fixed once, not per-caller.
+must re-exec a child with the env built here (tests/conftest.py:43-44's
+relaunch).  Keeping the recipe in one place means a future tunnel change
+is fixed once, not per-caller.
 """
 
 from __future__ import annotations

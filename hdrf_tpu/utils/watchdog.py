@@ -1,7 +1,7 @@
 """Stall watchdog: flags in-flight operations that exceed their budget.
 
 Equivalent of the reference's slow-node/slow-disk detection
-(DataNodeMetrics' SlowPeer reports and the ``/stacks`` servlet Hadoop's
+(DataNodeMetrics.java:557's SlowPeer reports and the ``/stacks`` servlet Hadoop's
 HttpServer2 exposes for hung-daemon triage): a per-daemon background thread
 scans the in-flight table every ``tick_s`` and, when an op has been running
 past its budget, bumps ``stall_total`` on the daemon's registry, captures a

@@ -8,6 +8,7 @@ import time
 import pytest
 
 from hdrf_tpu.testing.minicluster import MiniCluster
+from hdrf_tpu.utils import codec
 
 
 @pytest.fixture(scope="module")
@@ -29,7 +30,12 @@ class TestEndToEnd:
             st = c.stat("/e2e/direct")
             assert st["length"] == len(data) and st["blocks"] == 3
 
-    @pytest.mark.parametrize("scheme", ["lz4", "zstd", "dedup_lz4"])
+    @pytest.mark.parametrize("scheme", [
+        "lz4",
+        pytest.param("zstd", marks=pytest.mark.skipif(
+            not codec.available("zstd"),
+            reason="zstandard module not installed")),
+        "dedup_lz4"])
     def test_write_read_reduced(self, cluster, scheme):
         base = blob(2, 200_000)
         data = base * 3 + blob(3, 100_000)  # dedup-friendly

@@ -424,3 +424,9 @@ class TestBenchContract:
         for key in ("dispatch_total", "readback_total", "compiles_total",
                     "stall_total", "h2d_bytes_total", "d2h_bytes_total"):
             assert key in doc["ledger"], f"ledger missing {key}"
+        # reduction-effectiveness + health-intelligence fields: the dedup
+        # ratio recomputed from the pass's chunk index (>= 1.0 by
+        # definition) and the outlier detector's slow-peer verdict (0 —
+        # the bench runs no cluster)
+        assert float(doc["dedup_ratio"]) >= 1.0
+        assert int(doc["slow_peer_count"]) == 0

@@ -7,6 +7,7 @@ import pytest
 
 from hdrf_tpu.config import ReductionConfig
 from hdrf_tpu.index.chunk_index import ChunkIndex
+from hdrf_tpu.utils import codec
 from hdrf_tpu.reduction import scheme as schemes
 from hdrf_tpu.reduction.scheme import ReductionContext
 from hdrf_tpu.storage.container_store import ContainerStore
@@ -34,7 +35,11 @@ def test_registry_has_all_schemes():
         schemes.get("snappy-nope")
 
 
-@pytest.mark.parametrize("name", ["direct", "lz4", "gzip", "zstd"])
+@pytest.mark.parametrize("name", [
+    "direct", "lz4", "gzip",
+    pytest.param("zstd", marks=pytest.mark.skipif(
+        not codec.available("zstd"),
+        reason="zstandard module not installed"))])
 def test_compress_schemes_roundtrip(name, tmp_path):
     s = schemes.get(name)
     ctx = ReductionContext(config=ReductionConfig())

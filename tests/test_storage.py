@@ -69,6 +69,8 @@ class TestContainerStore:
         assert cs.read_chunks(new_locs) == [b"a" * 100, b"b" * 50]
 
     def test_zstd_codec(self, tmp_path):
+        pytest.importorskip("zstandard",
+                            reason="zstandard module not installed")
         cs = ContainerStore(str(tmp_path), container_size=100, lanes=1, codec="zstd")
         locs = cs.append_chunks([b"q" * 90])
         cs.flush_open()

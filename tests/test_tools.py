@@ -4,6 +4,7 @@ Balancer surface."""
 
 import io
 import json
+import os
 import time
 from contextlib import redirect_stdout
 
@@ -59,9 +60,36 @@ class TestDfsCli:
         nn = nn_arg(cluster)
         rc, out = run(["dfsadmin", "--namenode", nn, "-report"])
         assert rc == 0 and out.count("live") == 3
+        # enriched -report: cluster summary header + reduction accounting
+        # + health intelligence lines precede the per-DN lines
+        assert "Cluster: up=3 down=0" in out
+        assert "dedup_ratio=" in out and "slow_peers=" in out
+        assert "stalls=" in out and "failed_volumes=" in out
         rc, out = run(["dfsadmin", "--namenode", nn, "-metrics"])
         assert rc == 0 and "namenode" in json.loads(out)
         assert run(["dfsadmin", "--namenode", nn, "-savenamespace"])[0] == 0
+
+    def test_dfsadmin_slow_peers_json(self, cluster):
+        nn = nn_arg(cluster)
+        rc, out = run(["dfsadmin", "--namenode", nn, "-slowPeers"])
+        assert rc == 0
+        doc = json.loads(out)
+        for key in ("slow_peers", "slow_volumes", "peer_medians_s_per_mb",
+                    "volume_probe_medians_s", "reporters"):
+            assert key in doc, f"-slowPeers missing {key}"
+
+
+class TestParityCitations:
+    def test_every_module_cites_references(self):
+        """tools/check_parity.py as a tier-1 gate: every hdrf_tpu module
+        docstring carries at least one file:line reference citation (the
+        CLAUDE.md parity convention)."""
+        import hdrf_tpu
+        from hdrf_tpu.tools import check_parity
+
+        root = os.path.dirname(os.path.abspath(hdrf_tpu.__file__))
+        problems = check_parity.check(root)
+        assert not problems, "\n".join(problems)
 
 
 class TestOfflineViewers:
