@@ -206,6 +206,31 @@ def _cpu_full(blocks: list[np.ndarray], cdc, tmp: str, tag: str):
                                    ist["unique_chunk_bytes"]))
 
 
+def _cdc_fused_summary() -> dict:
+    """Fused-CDC ledger sub-dict for the JSON line: how the run's CDC front
+    end actually dispatched.  ``candidate_d2h_events`` counts XLA-prep
+    completions (each one IS a packed-candidate readback) — zero in fused
+    steady state; a nonzero value alongside fused dispatches means the
+    overflow fallback fired (tests/test_cdc_pallas.py pins both)."""
+    from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode
+    from hdrf_tpu.utils import device_ledger
+
+    evs = device_ledger.events_snapshot()
+    prep_ops = {"resident.prep", "resident.prep_batch",
+                "resident.prep_retry"}
+    return {
+        "mode": cdc_pallas_mode(),
+        "fused_dispatches": sum(1 for e in evs if e["kind"] == "dispatch"
+                                and e["op"] == "resident.cdc_fused"),
+        "xla_prep_dispatches": sum(1 for e in evs
+                                   if e["kind"] == "dispatch"
+                                   and e["op"] in prep_ops),
+        "candidate_d2h_events": sum(1 for e in evs
+                                    if e["kind"] == "dispatch"
+                                    and e["op"] in prep_ops),
+    }
+
+
 def _slow_peer_count() -> int:
     """Slow peers flagged by the cluster outlier detector — the bench runs
     no cluster, so this is the detector's verdict over an empty report set
@@ -257,6 +282,7 @@ def main() -> None:
                 "dedup_ratio": round(cpu_dr, 4),
                 "slow_peer_count": _slow_peer_count(),
                 "ledger": led,
+                "cdc_fused": _cdc_fused_summary(),
                 "stalls": led.get("stall_total", 0),
             }))
             return
@@ -576,6 +602,7 @@ def main() -> None:
                 if idx_summary else 1.0,
             "slow_peer_count": _slow_peer_count(),
             "ledger": led,
+            "cdc_fused": _cdc_fused_summary(),
             "stalls": led.get("stall_total", 0),
         }))
     finally:

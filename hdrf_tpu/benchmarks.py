@@ -400,6 +400,95 @@ def bench_sort(args) -> None:
                       "reduction_pct": round(100 * (1 - packed / full), 1)}))
 
 
+def bench_cdc(args) -> None:
+    """Fused Pallas CDC front end A/B: ops/cdc_pallas.py (device-side cut
+    selection, in-kernel BE image) vs the XLA ``_prep`` pipeline stage
+    (ops/resident.py: MXU BE word image + gear scan + packed candidate
+    bitmap, host-selected cuts), slope method — k salted iterations in ONE
+    dispatch with a dependent readback divides out the ~100 ms transport
+    constant (PERF_NOTES.md round 4).  Prints exactly ONE JSON line, with
+    the per-block readback byte ledger (the XLA path's packed-candidate
+    D2H vs the fused path's cut table) and the serial awaited-boundary
+    count each shape pays per group.  Without a chip the kernel runs in
+    the Pallas interpreter — a correctness-grade timing, flagged in the
+    line (the round-6 precedent)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hdrf_tpu.config import CdcConfig
+    from hdrf_tpu.ops import cdc_pallas, resident
+
+    cdc = CdcConfig()
+    r = resident.ResidentReducer(cdc, fused_mode="off")
+    n = args.mb << 20
+    rng = np.random.default_rng(17)
+    a = rng.integers(0, 256, n, dtype=np.uint8)
+    a[: n // 4] = rng.integers(97, 123, size=n // 4, dtype=np.uint8)
+
+    mode = cdc_pallas.cdc_pallas_mode()
+    interpret = args.interpret or mode != "mosaic"
+    plan = cdc_pallas.plan_for(n, r.mask, cdc.mask_bits, cdc.min_chunk,
+                               cdc.max_chunk, r._b_small, r._b_big)
+    buf = np.zeros(plan.n_pad, dtype=np.uint8)
+    buf[:n] = a
+    w2d = jax.device_put(buf.view(np.uint32).reshape(-1, 128))
+    pad512 = n + (-n) % 512
+    blk = jax.device_put(np.concatenate([a, np.zeros(pad512 - n,
+                                                     np.uint8)]))
+    cap_x = max(1, min(pad512 // 32,
+                       max(1024, (n >> max(cdc.mask_bits - 1, 0)) + 1024)))
+
+    def measure(build):
+        def timed(k):
+            f = jax.jit(build(k))
+            int(f(w2d if build is build_fused else blk))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(args.repeats):
+                int(f(w2d if build is build_fused else blk))
+            return (time.perf_counter() - t0) / args.repeats
+        t1, tk = timed(1), timed(args.inner)
+        return (tk - t1) / (args.inner - 1)
+
+    def build_fused(k):
+        def f(w):
+            acc = jnp.int32(0)
+            for i in range(k):
+                _, table, _, _ = cdc_pallas.fused_block(
+                    w ^ jnp.uint32(i), plan, interpret)  # salt defeats CSE
+                acc += table[0, cdc_pallas.H_COUNT]
+            return acc
+        return f
+
+    def build_xla(k):
+        def f(b):
+            acc = jnp.uint32(0)
+            for i in range(k):
+                words, cand = resident._prep_impl(b ^ jnp.uint8(i & 0xFF),
+                                                  r.mask, cap_x,
+                                                  r.pad_words)
+                acc += jnp.max(words) + cand[0].astype(jnp.uint32)
+            return acc
+        return f
+
+    fused_ms = measure(build_fused) * 1e3
+    xla_ms = measure(build_xla) * 1e3
+    print(json.dumps({
+        "op": "cdc_prep [fused pallas vs xla prep, slope A/B]",
+        "mb": args.mb, "backend": jax.default_backend(),
+        "interpret": interpret,
+        "fused_ms_per_block": round(fused_ms, 3),
+        "xla_ms_per_block": round(xla_ms, 3),
+        "speedup": round(xla_ms / fused_ms, 3) if fused_ms > 0 else None,
+        # Per-block readback ledger: what each shape must await before SHA
+        # can be PLACED (XLA: packed candidates -> host select -> offsets
+        # re-upload; fused: nothing — the cut table D2H overlaps SHA).
+        "cand_d2h_bytes_per_block_xla": (1 + 2 * cap_x) * 4,
+        "cut_table_d2h_bytes_per_block_fused":
+            (cdc_pallas.TABLE_HDR + plan.cap) * 4,
+        "serial_awaited_boundaries": {"xla": 2, "fused": 1},
+    }))
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="hdrf-bench")
     sub = p.add_subparsers(dest="which", required=True)
@@ -430,6 +519,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="run the Pallas kernel through the interpreter "
                         "(correctness spot-check on the CPU mesh)")
     d.set_defaults(fn=bench_sort)
+    d = sub.add_parser("cdc")
+    d.add_argument("--mb", type=int, default=16)
+    d.add_argument("--inner", type=int, default=4,
+                   help="k for the slope method's long pass")
+    d.add_argument("--repeats", type=int, default=3)
+    d.add_argument("--interpret", action="store_true",
+                   help="force the fused kernel through the Pallas "
+                        "interpreter (correctness-grade timing)")
+    d.set_defaults(fn=bench_cdc)
     d = sub.add_parser("recon")
     d.add_argument("--mb", type=int, default=64)
     d.add_argument("--repeats", type=int, default=3)

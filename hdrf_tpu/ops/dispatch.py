@@ -122,12 +122,17 @@ def chunk_and_fingerprint(data: bytes | np.ndarray, cdc: CdcConfig,
             from hdrf_tpu.parallel.sharded import reduce_sharded
 
             return reduce_sharded(data, cdc, mesh)
+        from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode
         from hdrf_tpu.ops.resident import ResidentReducer
 
-        key = (cdc.mask_bits, cdc.min_chunk, cdc.max_chunk)
+        # The fused-CDC mode is part of the key: a reducer pins its mode at
+        # construction (jit-cache coherence), so flipping HDRF_CDC_PALLAS
+        # mid-process must select a different reducer, not mutate one.
+        key = (cdc.mask_bits, cdc.min_chunk, cdc.max_chunk,
+               cdc_pallas_mode())
         r = _resident_cache.get(key)
         if r is None:
-            r = _resident_cache[key] = ResidentReducer(cdc)
+            r = _resident_cache[key] = ResidentReducer(cdc, fused_mode=key[3])
         return r.reduce(data)
     cuts = chunk_cuts(data, cdc, backend)
     return cuts, fingerprints(data, cuts, backend)

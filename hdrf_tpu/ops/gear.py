@@ -34,6 +34,13 @@ import numpy as np
 
 WINDOW = 32  # bytes contributing to the hash: h[i] covers b[i-31..i]
 
+# Window-warmup convention shared by every candidate producer (this module,
+# ops/cdc_pallas.py, native hdrf_gear_candidates): the first WINDOW-1
+# positions hold partial-window hashes and can never be cuts, so the
+# smallest admissible 1-based cut position is WINDOW.  Pinned by a shared
+# test vector in tests/test_cdc_pallas.py.
+MIN_CANDIDATE_POS1 = WINDOW
+
 
 def _fmix32_np(z: np.ndarray) -> np.ndarray:
     z = z.astype(np.uint32)
@@ -100,7 +107,7 @@ def candidate_bitmap_words(block_u8: jax.Array, mask: jax.Array,
     pos1 = jnp.arange(1, n + 1, dtype=jnp.uint32)
     if pos1_base is not None:
         pos1 = pos1 + pos1_base
-    is_cand = ((h & mask) == 0) & (pos1 >= WINDOW)
+    is_cand = ((h & mask) == 0) & (pos1 >= MIN_CANDIDATE_POS1)
     return pack_bitmap_words(is_cand)
 
 

@@ -26,6 +26,18 @@ Host<->device traffic per 64 MiB block: 64 MiB H2D + ~100 KiB of offsets
 down, ~250 KiB of candidates+digests up.  All readbacks are started with
 ``copy_to_host_async`` so a caller that overlaps blocks (submit k+1 before
 finishing k) hides dispatch and D2H latency entirely.
+
+Fused front end (default on TPU, gated by HDRF_CDC_PALLAS): the batched
+path routes stages 1-2 through ops/cdc_pallas.py instead — one Pallas
+kernel forms the BE word image AND selects the final cuts on device,
+binning chunk offset/length lanes into two fixed-capacity device tables
+that feed the bucket SHA **without any host round trip**: the SHA
+dispatches are enqueued before the cut table is read back, so the
+candidate D2H and one awaited dispatch boundary per group (~100 ms each
+through the tunnel) disappear from the steady state.  A kernel-reported
+capacity overflow (header count) falls back to this module's XLA prep +
+host native-select path, which also remains the oracle and the CPU-mesh /
+device-resident-input path.
 """
 
 from __future__ import annotations
@@ -205,6 +217,61 @@ def _bucket_sha_best(words: jax.Array, ol, bucket: int):
     return _bucket_sha(words, jax.device_put(ol), bucket)
 
 
+def _bucket_sha_dev(words: jax.Array, ol: jax.Array, bucket: int):
+    """_bucket_sha_best for an ALREADY-device-resident ol table (the fused
+    CDC path: the offset/length lanes never visit the host, so there is no
+    device_put).  Traceable under jit."""
+    if jax.default_backend() != "cpu" and words.shape[0] % 128 == 0:
+        return _bucket_sha_dma(words, ol, bucket)
+    return _bucket_sha(words, ol, bucket)
+
+
+@functools.partial(jax.jit, static_argnames=("plan", "pad_words", "b_big",
+                                             "interpret"))
+def _fused_batch(w3d: jax.Array, plan, pad_words: int, b_big: int,
+                 interpret: bool):
+    """K-block fused CDC + bucket SHA in ONE device program (the loop is
+    unrolled per the _prep_batch precedent).  Per block: the cdc_pallas
+    select kernel emits the BE word image, the cut table, and two binned
+    offset/length lane tables; lane offsets are rebased to the flat
+    multi-block word image and the two bucket SHA passes run over the
+    concatenated fixed-capacity lanes — chunk COUNTS are not needed to
+    enqueue, which is what removes the awaited prep boundary.
+
+    Returns (tables i32[K, 8+cap], digests u8[K*Ls + K*Lb, 32]) with small
+    lanes first: digest row of block k's small-lane j = k*Ls + j, big-lane
+    j = K*Ls + k*Lb + j.
+    """
+    from hdrf_tpu.ops import cdc_pallas
+
+    k = w3d.shape[0]
+    stride_words = plan.T * plan.R * 128 + pad_words
+    words_l, tables_l, ols_l, olb_l = [], [], [], []
+    for i in range(k):
+        if w3d.dtype == jnp.uint8:
+            # HBM-resident u8 block (the streamed worker deployment): LE
+            # words via the MXU combine — a u8->u32 bitcast materializes
+            # the 32x-padded minor-dim-4 layout (be_word_image's rationale).
+            padded = jnp.pad(w3d[i], (0, plan.n_pad - w3d.shape[1]))
+            w2d = cdc_pallas.le_word_image(padded).reshape(-1, 128)
+        else:
+            w2d = w3d[i]
+        wbe, table, ols, olb = cdc_pallas.fused_block(w2d, plan,
+                                                      interpret)
+        words_l.append(jnp.concatenate(
+            [wbe.reshape(-1), jnp.zeros(pad_words, jnp.uint32)]))
+        tables_l.append(table[0])
+        base = jnp.int32(i * stride_words * 4)
+        ols_l.append(ols.at[0].add(base))
+        olb_l.append(olb.at[0].add(base))
+    words = jnp.concatenate(words_l)
+    ol_s = jnp.concatenate(ols_l, axis=1)
+    ol_b = jnp.concatenate(olb_l, axis=1)
+    digs = jnp.concatenate([_bucket_sha_dev(words, ol_s, plan.b_small),
+                            _bucket_sha_dev(words, ol_b, b_big)], axis=0)
+    return jnp.stack(tables_l), digs
+
+
 @dataclasses.dataclass
 class BatchJob:
     """A group of K equal-length blocks reduced with one dispatch + one
@@ -221,6 +288,13 @@ class BatchJob:
     _sha_parts: tuple | None = None
     _ev: object = None        # ledger token: prep dispatch -> cand readback
     _ev_sha: list | None = None  # ledger tokens: sha dispatches -> digest rb
+    # Fused-CDC path state (cdc_pallas): cuts selected on device, SHA
+    # enqueued against fixed-capacity lane tables before any readback.
+    fused: bool = False
+    tables: jax.Array | None = None   # (K, 8+cap) cut tables (D2H async)
+    plan: object = None               # cdc_pallas.FusedPlan
+    _digs: jax.Array | None = None    # (K*Ls + K*Lb, 32) fused digests
+    _host: list | None = None         # host u8 blocks for overflow fallback
 
 
 @dataclasses.dataclass
@@ -245,9 +319,16 @@ class ResidentReducer:
         results = [r.finish(j) for j in jobs]     # (cuts, digests)
     """
 
-    def __init__(self, cdc: CdcConfig | None = None):
+    def __init__(self, cdc: CdcConfig | None = None,
+                 fused_mode: str | None = None):
+        from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode
+
         self.cdc = cdc or CdcConfig()
         self.mask = gear_mask(self.cdc)
+        # 'mosaic' | 'interpret' | 'off' — resolved once so a reducer's jit
+        # cache stays coherent; dispatch.py keys its reducer cache on this.
+        self.fused = fused_mode if fused_mode is not None \
+            else cdc_pallas_mode()
         # Gather windows must never clamp: pad the word image by the widest
         # bucket (max_chunk rounded up) + the funnel-shift lookahead word,
         # rounded to the 128-word row grid the Pallas DMA gather requires.
@@ -257,7 +338,10 @@ class ResidentReducer:
         # chunk, big bucket = exactly max_chunk.  Bucket widths are jit-cache
         # keys, not layout constraints — pow2 rounding here would double the
         # padded SHA work for the mass of the distribution.
-        self._b_small = (2 << self.cdc.mask_bits) // 64
+        # Clamped to the big bucket: a degenerate config whose expected
+        # chunk (2<<mask_bits) exceeds max_chunk must not widen the small
+        # gather window past the word-image padding.
+        self._b_small = max(1, min((2 << self.cdc.mask_bits) // 64, max_nb))
         self._b_big = max_nb
         # Batched path: four buckets (avg, 2x, 4x, max) — padded gather
         # bytes drop from ~2.45x to ~1.53x of the block at the measured
@@ -277,7 +361,17 @@ class ResidentReducer:
         ``datas``: list of host byte buffers (bytes / u8 ndarray) all the
         same length, or an already-HBM-resident (K, n) u8 device array
         (the streamed TPU-worker deployment).
+
+        Host-byte groups route through the fused Pallas CDC kernel when
+        enabled (cuts selected on device, SHA enqueued with no candidate
+        readback); device-resident inputs and ``fused == 'off'`` take the
+        XLA prep + host-select path.
         """
+        if self.fused != "off":
+            return self._submit_many_fused(datas)
+        return self._submit_many_xla(datas)
+
+    def _submit_many_xla(self, datas) -> BatchJob:
         if isinstance(datas, jax.Array):
             k, n = datas.shape
             assert n > 0 and n % _PAD_GRID == 0
@@ -311,6 +405,62 @@ class ResidentReducer:
         return BatchJob(k=k, n=n, blocks=stacked, words=words, cand=cand,
                         cap=cap, true_n=true_n, _ev=ev)
 
+    def _submit_many_fused(self, datas) -> BatchJob:
+        """Fused-kernel group submit: ONE program selects cuts on device
+        and hashes both lane buckets; the cut-table readback and the SHA
+        digests start D2H together — nothing is awaited here."""
+        from hdrf_tpu.ops import cdc_pallas
+
+        if isinstance(datas, jax.Array):
+            # HBM-resident group: LE words form on device (MXU combine in
+            # _fused_batch); the raw array doubles as the fallback input.
+            k, true_n = datas.shape
+            assert true_n > 0 and true_n % _PAD_GRID == 0
+            arrs, w3d, h2d = datas, datas, 0
+        else:
+            arrs = [np.ascontiguousarray(
+                        np.frombuffer(d, dtype=np.uint8)
+                        if not isinstance(d, np.ndarray) else d)
+                    for d in datas]
+            true_n = arrs[0].size
+            assert all(a.size == true_n for a in arrs), \
+                "submit_many needs equal lengths"
+            assert true_n > 0
+            k, w3d = len(arrs), None
+        plan = cdc_pallas.plan_for(true_n, self.mask, self.cdc.mask_bits,
+                                   self.cdc.min_chunk, self.cdc.max_chunk,
+                                   self._b_small, self._b_big)
+        stride = plan.n_pad + 4 * self.pad_words
+        assert k * stride < (1 << 31), \
+            "batch too large for i32 flat offsets; split it"
+        if w3d is None:
+            buf = np.zeros((k, plan.n_pad), dtype=np.uint8)
+            for i, a in enumerate(arrs):
+                buf[i, :true_n] = a
+            # Host-side u32 view = free little-endian word formation; the
+            # kernel byteswaps to BE in-register (no separate MXU pass).
+            w3d = jax.device_put(buf.view(np.uint32).reshape(k, -1, 128))
+            h2d = k * plan.n_pad
+        interpret = self.fused == "interpret"
+        ev = _ledger.dispatch("resident.cdc_fused", batch=k,
+                              h2d_bytes=h2d,
+                              key=(k, plan.n_pad, plan.cap, self.fused))
+        tables, digs = _fused_batch(w3d, plan, self.pad_words, self._b_big,
+                                    interpret)
+        tables.copy_to_host_async()
+        # SHA is enqueued already — against fixed-capacity lane tables, so
+        # no cut count (hence no readback) gates it.  One ledger dispatch
+        # per bucket keeps parity with the XLA path's accounting.
+        evs = [_ledger.dispatch("resident.sha", batch=k,
+                                key=(b, lanes, "fused"))
+               for b, lanes in ((plan.b_small, k * plan.Ls),
+                                (self._b_big, k * plan.Lb))]
+        digs.copy_to_host_async()
+        return BatchJob(k=k, n=plan.n_pad, blocks=None, words=None,
+                        cand=None, cap=plan.cap, true_n=true_n,
+                        fused=True, tables=tables, plan=plan, _digs=digs,
+                        _host=arrs, _ev=ev, _ev_sha=evs)
+
     def _cuts_from_cand(self, cand_row: np.ndarray, cap: int, block,
                         true_n: int) -> np.ndarray:
         """Candidate row -> selected cut points.  The packed layout is
@@ -336,7 +486,54 @@ class ResidentReducer:
         return native.cdc_select(pos, true_n, self.cdc.min_chunk,
                                  self.cdc.max_chunk)
 
+    def _start_sha_fused(self, bj: BatchJob) -> None:
+        """Await the cut tables (the SHA work is already enqueued), derive
+        each chunk's digest row from the kernel's two-bucket binning rule,
+        or — on a kernel-reported capacity overflow — discard the fused
+        lanes and rerun the whole group through the XLA oracle path (cut
+        boundaries are never truncated)."""
+        from hdrf_tpu.ops import cdc_pallas as cp
+
+        tables = np.asarray(bj.tables)        # the one awaited readback
+        _ledger.readback(bj._ev, d2h_bytes=tables.nbytes)
+        bj._ev = None
+        bj.tables = None
+        if tables[:, cp.H_OVERFLOW].any():
+            for ev in bj._ev_sha or ():       # fused SHA results discarded
+                _ledger.readback(ev, d2h_bytes=0)
+            bj._ev_sha = None
+            bj._digs = None
+            nj = self._submit_many_xla(bj._host)
+            bj.fused = False
+            bj._host = None
+            bj.n, bj.true_n, bj.cap = nj.n, nj.true_n, nj.cap
+            bj.blocks, bj.words, bj.cand = nj.blocks, nj.words, nj.cand
+            bj._ev = nj._ev
+            self.start_sha_many(bj)
+            return
+        plan = bj.plan
+        cuts_all, place = [], []
+        for i in range(bj.k):
+            nc = int(tables[i, cp.H_COUNT])
+            cuts = tables[i, cp.TABLE_HDR:cp.TABLE_HDR + nc].astype(
+                np.uint64)
+            cuts_all.append(cuts)
+            starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
+            lens = cuts.astype(np.int64) - starts
+            small = (lens + 9 + 63) // 64 <= plan.b_small
+            rank = np.where(small, np.cumsum(small) - 1,
+                            np.cumsum(~small) - 1)
+            place.append(np.where(small, i * plan.Ls + rank,
+                                  bj.k * plan.Ls + i * plan.Lb + rank))
+        bj.cuts = cuts_all
+        bj._sha_parts = ("fused", place, bj._digs)
+        bj._digs = None
+        bj._host = None
+
     def start_sha_many(self, bj: BatchJob) -> None:
+        if bj.fused:
+            self._start_sha_fused(bj)
+            return
         cand = np.asarray(bj.cand)            # ONE readback for the group
         _ledger.readback(bj._ev, d2h_bytes=cand.nbytes)
         bj._ev = None
@@ -387,6 +584,14 @@ class ResidentReducer:
     def finish_many(self, bj: BatchJob) -> list[tuple[np.ndarray, np.ndarray]]:
         if bj._sha_parts is None:
             self.start_sha_many(bj)
+        if bj.fused:
+            _, place, digs_dev = bj._sha_parts
+            digs = np.asarray(digs_dev)
+            for i, ev in enumerate(bj._ev_sha or ()):
+                _ledger.readback(ev, d2h_bytes=digs.nbytes if i == 0 else 0)
+            bj._ev_sha = None
+            bj._sha_parts = None
+            return [(c, digs[rows]) for c, rows in zip(bj.cuts, place)]
         sels, lane_counts, digs_dev = bj._sha_parts
         outs = [np.empty((len(c), 32), dtype=np.uint8) for c in bj.cuts]
         if digs_dev is not None:
@@ -407,9 +612,19 @@ class ResidentReducer:
     def max_group(self, n: int) -> int:
         """Largest equal-length group of n-byte blocks one submit_many can
         take: bounded by i32 flat byte offsets in the bucket gather and a
-        cap on the unrolled _prep_batch program size."""
+        cap on the unrolled _prep_batch program size.  The fused path pads
+        to its (larger) supertile grid, so both strides bound the group."""
         n_pad = n + (-n) % _PAD_GRID
         stride = n_pad + 4 * self.pad_words
+        if self.fused != "off":
+            from hdrf_tpu.ops import cdc_pallas
+
+            plan = cdc_pallas.plan_for(max(n, 1), self.mask,
+                                       self.cdc.mask_bits,
+                                       self.cdc.min_chunk,
+                                       self.cdc.max_chunk,
+                                       self._b_small, self._b_big)
+            stride = max(stride, plan.n_pad + 4 * self.pad_words)
         return max(1, min(((1 << 31) - 1) // stride, 16))
 
     def reduce_many(self, datas: list) -> list[tuple[np.ndarray, np.ndarray]]:
@@ -535,7 +750,16 @@ class ResidentReducer:
         return job.cuts, out
 
     def reduce(self, data: bytes | np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """Synchronous single-block convenience: (cuts, digests)."""
+        """Synchronous single-block convenience: (cuts, digests).  Host
+        bytes ride the fused group path as a group of one; device-resident
+        arrays and n == 0 keep the per-block XLA path."""
+        if self.fused != "off" and not isinstance(data, jax.Array):
+            a = (np.frombuffer(data, dtype=np.uint8)
+                 if not isinstance(data, np.ndarray) else data)
+            if a.size:
+                bj = self.submit_many([a])
+                self.start_sha_many(bj)
+                return self.finish_many(bj)[0]
         job = self.submit(data)
         self.start_sha(job)
         return self.finish(job)

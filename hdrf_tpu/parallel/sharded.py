@@ -128,21 +128,43 @@ def _local_candidate_words(local: jax.Array, mask: jax.Array,
     return words, jnp.sum(is_cand.astype(jnp.int32))
 
 
-def candidate_words_sharded(mesh: Mesh):
+def candidate_words_sharded(mesh: Mesh, fused: str | None = None):
     """Jitted all-position Gear candidate scan, byte axis sharded over 'seq'.
 
     Returns ``fn(block u8[N], mask u32) -> (words u32[N/32], count i32)`` with
     the block sharded P('seq'); words come back with the same layout.  Output
     is bit-identical to the single-device ops.gear._candidate_words bitmap.
-    """
-    n_seq = mesh.shape["seq"]
 
-    def scan(block: jax.Array, mask: jax.Array):
-        words, cnt = _local_candidate_words(block, mask, n_seq)
-        return words, jax.lax.psum(cnt, "seq")
+    ``fused`` routes the per-shard scan through the fused Pallas kernel
+    (ops/cdc_pallas.py) instead of the XLA doubling scan — same halo, same
+    packed-bitmap contract, asserted bit-identical in tests/test_cdc_pallas.py.
+    None resolves via cdc_pallas.cdc_pallas_mode() ('off' on the CPU mesh).
+    """
+    from hdrf_tpu.ops import cdc_pallas
+
+    n_seq = mesh.shape["seq"]
+    if fused is None:
+        fused = cdc_pallas.cdc_pallas_mode()
+
+    kw = {}
+    if fused != "off":
+        interp = fused == "interpret"
+        # shard_map has no replication rule for pallas_call; the psum below
+        # makes the count output replicated by construction, so the check
+        # is safely skipped on the fused route.
+        kw["check_rep"] = False
+
+        def scan(block: jax.Array, mask: jax.Array):
+            words, cnt = cdc_pallas.local_candidate_words_pallas(
+                block, mask, n_seq, interpret=interp)
+            return words, jax.lax.psum(cnt, "seq")
+    else:
+        def scan(block: jax.Array, mask: jax.Array):
+            words, cnt = _local_candidate_words(block, mask, n_seq)
+            return words, jax.lax.psum(cnt, "seq")
 
     fn = _shard_map(scan, mesh=mesh, in_specs=(P("seq"), P()),
-                    out_specs=(P("seq"), P()))
+                    out_specs=(P("seq"), P()), **kw)
     return jax.jit(fn)
 
 
@@ -337,8 +359,10 @@ def reduce_sharded(data: bytes | np.ndarray, cdc, mesh: Mesh):
     buf = np.zeros(n + ((-n) % grid), dtype=np.uint8)
     buf[:n] = a
     block_sh = _put_global(buf, NamedSharding(mesh, P("seq")))
-    ev = _ledger.dispatch("sharded.scan", key=(buf.size, n_seq))
-    words, _ = candidate_words_sharded(mesh)(
+    from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode
+    scan_mode = cdc_pallas_mode()
+    ev = _ledger.dispatch("sharded.scan", key=(buf.size, n_seq, scan_mode))
+    words, _ = candidate_words_sharded(mesh, fused=scan_mode)(
         block_sh, jnp.uint32(mask & 0xFFFFFFFF))
     wv = _fetch_global(words)
     _ledger.readback(ev, d2h_bytes=wv.nbytes)

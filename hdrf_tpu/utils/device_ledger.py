@@ -100,6 +100,12 @@ def dispatch(op: str, *, batch: int = 1, h2d_bytes: int = 0,
             _M.incr("compiles_total")
             _event(op, "compile", t0=time.time(), dur_us=0.0, batch=batch,
                    nbytes=0)
+    # Enqueue marker: ring position (id) establishes dispatch ORDER, letting
+    # tests pin pipeline structure — e.g. that the fused CDC path enqueues
+    # its SHA dispatches BEFORE the cut-table readback completes (one fewer
+    # awaited boundary than the XLA prep -> host-select -> SHA shape).
+    _event(op, "enqueue", t0=time.time(), dur_us=0.0, batch=batch,
+           nbytes=h2d_bytes)
     return _Pending(op, batch, h2d_bytes)
 
 

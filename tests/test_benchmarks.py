@@ -38,6 +38,22 @@ def test_reduction_throughput():
     assert out[0]["chunks"] > 0
 
 
+def test_cdc_harness_one_json_line():
+    """`benchmarks cdc` contract: EXACTLY one JSON line carrying the
+    fused-vs-XLA slope A/B and the per-block readback byte ledger (the
+    ISSUE 4 acceptance shape).  Tiny corpus; the fused kernel runs in the
+    Pallas interpreter on the CPU mesh."""
+    out = run(["cdc", "--mb", "1", "--inner", "2", "--repeats", "1"])
+    assert len(out) == 1
+    (o,) = out
+    assert o["op"].startswith("cdc_prep")
+    assert o["interpret"] is True  # no chip on the test mesh
+    assert o["fused_ms_per_block"] > 0 and o["xla_ms_per_block"] > 0
+    assert o["cand_d2h_bytes_per_block_xla"] > \
+        o["cut_table_d2h_bytes_per_block_fused"]
+    assert o["serial_awaited_boundaries"] == {"xla": 2, "fused": 1}
+
+
 def test_sort_harness():
     out = run(["sort", "--tiles", "1", "--entries", "2048", "--inner", "2",
                "--repeats", "1"])

@@ -1,0 +1,232 @@
+"""Fused Pallas CDC front end (ops/cdc_pallas.py) against the native C++
+oracle and the XLA prep path.
+
+Everything runs the kernel through the Pallas interpreter on the CPU mesh —
+the IDENTICAL kernel program Mosaic compiles on a chip (the sort_pallas test
+precedent) — so tier-1 pins the device-side cut selection bit-for-bit:
+boundaries, SHA digests, the capacity-overflow fallback, the shared
+window-warmup convention, and the ledger shape of the steady state (zero
+candidate readbacks, SHA enqueued before the cut table lands).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hdrf_tpu import native
+from hdrf_tpu.config import CdcConfig
+from hdrf_tpu.ops import cdc_pallas, gear
+from hdrf_tpu.ops.dispatch import gear_mask
+from hdrf_tpu.ops.resident import ResidentReducer
+from hdrf_tpu.utils import device_ledger
+
+
+def _oracle_cuts(a: np.ndarray, mask: int, mn: int, mx: int) -> np.ndarray:
+    return np.asarray(native.cdc_chunk(a.tobytes(), mask, mn, mx),
+                      dtype=np.uint64)
+
+
+def _corpora():
+    rng = np.random.default_rng(7)
+    text = rng.integers(97, 123, size=200_000, dtype=np.uint8)
+    yield "random", rng.integers(0, 256, 150_000, dtype=np.uint8), \
+        0x1FFF, 2048, 65536
+    yield "text-low-entropy", text, 0x1FFF, 2048, 65536
+    # sparse mask -> candidate droughts -> forced max-chunk runs
+    yield "forced-max-runs", rng.integers(0, 256, 120_000, dtype=np.uint8), \
+        0xFFFFFF, 512, 4096
+    # dense mask + tiny limits: every-word candidates, lo>hi edge traffic
+    yield "dense", rng.integers(0, 256, 30_000, dtype=np.uint8), 0x7, 8, 64
+    # block tail shorter than min_chunk: final cut is the short remainder
+    yield "tail-short-chunk", rng.integers(0, 256, 65536 + 37,
+                                           dtype=np.uint8), \
+        0x1FFF, 2048, 65536
+    # one supertile exactly / less than one supertile
+    yield "single-tile", rng.integers(0, 256, 65536, dtype=np.uint8), \
+        0x3FF, 256, 8192
+    yield "sub-tile", rng.integers(0, 256, 300, dtype=np.uint8), 0x3F, 16, 128
+
+
+@pytest.mark.parametrize("name,a,mask,mn,mx",
+                         list(_corpora()),
+                         ids=[c[0] for c in _corpora()])
+def test_device_cuts_bit_identical_to_native(name, a, mask, mn, mx):
+    cuts, overflowed = cdc_pallas.chunks_fused(
+        a, mask, mn, mx, mask_bits=max(bin(mask).count("1"), 1),
+        interpret=True)
+    assert not overflowed
+    np.testing.assert_array_equal(cuts, _oracle_cuts(a, mask, mn, mx))
+
+
+def test_candidate_at_position_zero_and_warmup_vector():
+    """The shared window-warmup convention (ISSUE 4 satellite): byte
+    position 0 (pos1 = 1) can NEVER be a cut and the first admissible
+    candidate is gear.MIN_CANDIDATE_POS1 — pinned with ONE vector against
+    all three producers (XLA gear scan, fused kernel, native oracle)
+    instead of two implicit implementations.  mask 0 makes every position
+    hash-eligible, so only the warmup rule decides."""
+    z = np.zeros(256, dtype=np.uint8)
+    pos = gear.gear_candidates_jax(z, mask=0)
+    assert pos[0] == gear.MIN_CANDIDATE_POS1 == gear.WINDOW
+    cuts, of = cdc_pallas.chunks_fused(z, 0, 1, 4096, interpret=True)
+    assert not of
+    want = _oracle_cuts(z, 0, 1, 4096)
+    assert cuts[0] == want[0] == gear.MIN_CANDIDATE_POS1
+    np.testing.assert_array_equal(cuts, want)
+
+
+def test_fused_reduce_matches_oracle_end_to_end():
+    """Cuts AND digests through the fused ResidentReducer pipeline (group
+    submit, on-device binning, enqueue-before-readback SHA) vs the XLA
+    oracle reducer."""
+    rng = np.random.default_rng(11)
+    cdc = CdcConfig()
+    rf = ResidentReducer(cdc, fused_mode="interpret")
+    rx = ResidentReducer(cdc, fused_mode="off")
+    datas = [rng.integers(0, 256, 1 << 19, dtype=np.uint8),
+             rng.integers(0, 256, 1 << 19, dtype=np.uint8),
+             rng.integers(0, 256, 333_333, dtype=np.uint8)]
+    for (cf, df), (cx, dx) in zip(rf.reduce_many(datas),
+                                  rx.reduce_many(datas)):
+        np.testing.assert_array_equal(cf, cx)
+        np.testing.assert_array_equal(df, dx)
+
+
+def test_fused_device_resident_input():
+    """The streamed-worker form: an HBM-resident (K, n) u8 group enters the
+    fused path through the on-device LE word image (MXU combine), no host
+    bytes involved."""
+    rng = np.random.default_rng(21)
+    cdc = CdcConfig()
+    rf = ResidentReducer(cdc, fused_mode="interpret")
+    rx = ResidentReducer(cdc, fused_mode="off")
+    dev = jax.device_put(rng.integers(0, 256, (2, 1 << 19), dtype=np.uint8))
+    bjf = rf.submit_many(dev)
+    rf.start_sha_many(bjf)
+    bjx = rx.submit_many(dev)
+    rx.start_sha_many(bjx)
+    for (cf, df), (cx, dx) in zip(rf.finish_many(bjf), rx.finish_many(bjx)):
+        np.testing.assert_array_equal(cf, cx)
+        np.testing.assert_array_equal(df, dx)
+
+
+def _events_after(last_id: int):
+    return [e for e in device_ledger.events_snapshot()
+            if e["id"] > last_id]
+
+
+def _last_event_id() -> int:
+    evs = device_ledger.events_snapshot()
+    return evs[-1]["id"] if evs else 0
+
+
+def test_overflow_fallback_low_entropy_corpus():
+    """ISSUE 4 satellite: a pathological block (zeros -> every position a
+    candidate) overflows the kernel's cut capacity; the header flags it and
+    the group reruns through the XLA prep + host-select oracle path —
+    boundaries are never silently truncated."""
+    cdc = CdcConfig(mask_bits=20, min_chunk=64, max_chunk=4096)
+    rf = ResidentReducer(cdc, fused_mode="interpret")
+    a = np.zeros(1 << 18, dtype=np.uint8)
+    # the plan's distributional cap really is smaller than the cut count
+    plan = cdc_pallas.plan_for(a.size, gear_mask(cdc), cdc.mask_bits,
+                               cdc.min_chunk, cdc.max_chunk,
+                               rf._b_small, rf._b_big)
+    want = _oracle_cuts(a, gear_mask(cdc), cdc.min_chunk, cdc.max_chunk)
+    assert len(want) > plan.cap
+    t0 = _last_event_id()
+    cuts, digs = rf.reduce(a)
+    np.testing.assert_array_equal(cuts, want)
+    starts = np.concatenate([[0], cuts[:-1]]).astype(np.uint64)
+    np.testing.assert_array_equal(
+        digs, native.sha256_batch(a, starts,
+                                  (cuts - starts).astype(np.uint64)))
+    ops = {e["op"] for e in _events_after(t0)}
+    assert "resident.cdc_fused" in ops         # the fused attempt
+    assert "resident.prep_batch" in ops        # ...and the oracle fallback
+
+
+def test_ledger_zero_candidate_d2h_and_one_fewer_boundary():
+    """ISSUE 4 satellite (the test_health zero-dispatch pinning pattern):
+    a steady-state fused reduce records ZERO candidate-readback events (no
+    resident.prep* at all), and the SHA dispatches are ENQUEUED before the
+    fused kernel's completion event — the prep->select->sha awaited
+    boundary the XLA path pays is structurally absent."""
+    rng = np.random.default_rng(31)
+    cdc = CdcConfig()
+    rf = ResidentReducer(cdc, fused_mode="interpret")
+    datas = [rng.integers(0, 256, 1 << 19, dtype=np.uint8)
+             for _ in range(2)]
+    rf.reduce_many(datas)                      # steady state: shapes warm
+    t0 = _last_event_id()
+    led0 = device_ledger.stamp()
+    bj = rf.submit_many(datas)
+    rf.start_sha_many(bj)
+    out = rf.finish_many(bj)
+    assert all(int(c[-1]) == datas[0].size for c, _ in out)
+    evs = _events_after(t0)
+    prep_ops = {"resident.prep", "resident.prep_batch",
+                "resident.prep_retry"}
+    assert not [e for e in evs if e["op"] in prep_ops], evs
+    # every SHA enqueue precedes the fused-CDC completion: nothing awaited
+    # stands between cut selection and SHA placement
+    fused_done = [e["id"] for e in evs if e["op"] == "resident.cdc_fused"
+                  and e["kind"] == "dispatch"]
+    sha_enq = [e["id"] for e in evs if e["op"] == "resident.sha"
+               and e["kind"] == "enqueue"]
+    assert fused_done and sha_enq
+    assert max(sha_enq) < min(fused_done)
+    # dispatch budget of the whole steady-state pass: 1 fused + 2 sha
+    led = device_ledger.delta(led0)
+    assert led["dispatch_total"] == 3, led
+
+    # contrast: the XLA path's SHA enqueues FOLLOW its prep completion
+    rx = ResidentReducer(cdc, fused_mode="off")
+    rx.reduce_many(datas)
+    t1 = _last_event_id()
+    bj = rx.submit_many(datas)
+    rx.start_sha_many(bj)
+    rx.finish_many(bj)
+    evs = _events_after(t1)
+    prep_done = [e["id"] for e in evs if e["op"] == "resident.prep_batch"
+                 and e["kind"] == "dispatch"]
+    sha_enq = [e["id"] for e in evs if e["op"] == "resident.sha"
+               and e["kind"] == "enqueue"]
+    assert prep_done and sha_enq
+    assert min(sha_enq) > max(prep_done)
+
+
+def test_sharded_scan_kernel_bit_identical():
+    """The scan-only kernel variant behind parallel/sharded.py: same halo,
+    same packed-bitmap words as the XLA per-shard scan, on the 8-virtual-
+    device mesh (shard_map + ppermute + psum actually execute)."""
+    from hdrf_tpu.parallel import make_mesh
+    from hdrf_tpu.parallel.sharded import candidate_words_sharded
+
+    mesh = make_mesh(n_data=1, n_seq=len(jax.devices()))
+    n_seq = mesh.shape["seq"]
+    rng = np.random.default_rng(3)
+    blk = jnp.asarray(rng.integers(0, 256, 4096 * n_seq, dtype=np.uint8))
+    mask = jnp.uint32(0x1FFF)
+    wx, cx = candidate_words_sharded(mesh, fused="off")(blk, mask)
+    wp, cp = candidate_words_sharded(mesh, fused="interpret")(blk, mask)
+    np.testing.assert_array_equal(np.asarray(wx), np.asarray(wp))
+    assert int(cx) == int(cp)
+
+
+def test_le_word_image_and_nibble_pack():
+    """Helper contracts: le_word_image == numpy's LE u32 view; the nibble
+    pack reproduces gear.pack_bitmap_words' bit layout exactly."""
+    rng = np.random.default_rng(9)
+    a = rng.integers(0, 256, 2048, dtype=np.uint8)
+    np.testing.assert_array_equal(
+        np.asarray(cdc_pallas.le_word_image(jnp.asarray(a))),
+        a.view(np.uint32))
+    bits = rng.integers(0, 2, 2048).astype(bool)
+    want = np.asarray(gear.pack_bitmap_words(jnp.asarray(bits)))
+    nib = np.asarray([int(bits[i]) | (int(bits[i + 1]) << 1)
+                      | (int(bits[i + 2]) << 2) | (int(bits[i + 3]) << 3)
+                      for i in range(0, 2048, 4)], dtype=np.int32)
+    got = np.asarray(cdc_pallas._pack_nibbles(jnp.asarray(nib)))
+    np.testing.assert_array_equal(got, want)

@@ -103,6 +103,30 @@ def test_reduce_many_batched(reducer):
         np.testing.assert_array_equal(digs, wd)
 
 
+def test_fused_front_end_matches_oracle():
+    """The fused Pallas front end (HDRF_CDC_PALLAS; interpret mode on the
+    CPU mesh) drives the SAME reduce_many contract: mixed sizes, a dense
+    zero block that fills the cut table to within two entries of the plan
+    cap (every position a candidate), and an empty block — all
+    oracle-identical.  The overflow fallback proper and the ledger shape
+    are pinned in tests/test_cdc_pallas.py."""
+    rng = np.random.default_rng(8)
+    reducer = ResidentReducer(CdcConfig(), fused_mode="interpret")
+    inputs = [rng.integers(0, 256, size=1 << 19, dtype=np.uint8),
+              rng.integers(0, 256, size=333_333, dtype=np.uint8),
+              np.zeros(1 << 19, dtype=np.uint8),
+              np.empty(0, np.uint8)]
+    results = reducer.reduce_many(inputs)
+    assert len(results) == len(inputs)
+    for data, (cuts, digs) in zip(inputs, results):
+        if data.size == 0:
+            assert cuts.size == 0 and digs.shape == (0, 32)
+            continue
+        wc, wd = _oracle(data, reducer.cdc)
+        np.testing.assert_array_equal(cuts, wc)
+        np.testing.assert_array_equal(digs, wd)
+
+
 def test_batch_lane_count_steps():
     from hdrf_tpu.ops.resident import _lane_count_geo
 
