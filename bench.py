@@ -240,6 +240,24 @@ def _slow_peer_count() -> int:
     return len(outlier.detect({}))
 
 
+def _resilience_summary() -> dict:
+    """Degraded-mode health of the run, read from the same process-wide
+    registries the daemons export (utils/retry.py breakers, block_receiver
+    fallback accounting).  The bench drives the reduction pipeline directly
+    (no DN worker edge), so both are 0 on a healthy run — a nonzero
+    ``breaker_open_total`` or ``degraded_writes`` means a dependency edge
+    tripped open or a write fell back to the in-process path mid-bench,
+    which taints the throughput verdict and must be visible in the line."""
+    from hdrf_tpu.utils import metrics
+
+    return {
+        "breaker_open_total":
+            metrics.registry("resilience").counter("breaker_open_total"),
+        "degraded_writes":
+            metrics.registry("block_receiver").counter("degraded_writes"),
+    }
+
+
 def main() -> None:
     from hdrf_tpu.config import CdcConfig
     from hdrf_tpu.ops.dispatch import resolve_backend
@@ -284,6 +302,7 @@ def main() -> None:
                 "ledger": led,
                 "cdc_fused": _cdc_fused_summary(),
                 "stalls": led.get("stall_total", 0),
+                "resilience": _resilience_summary(),
             }))
             return
 
@@ -604,6 +623,7 @@ def main() -> None:
             "ledger": led,
             "cdc_fused": _cdc_fused_summary(),
             "stalls": led.get("stall_total", 0),
+            "resilience": _resilience_summary(),
         }))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

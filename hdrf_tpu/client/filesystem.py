@@ -28,7 +28,7 @@ from hdrf_tpu import native
 from hdrf_tpu.config import ClientConfig
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import RpcClient, recv_frame
-from hdrf_tpu.utils import metrics, tracing
+from hdrf_tpu.utils import metrics, retry, tracing
 
 _M = metrics.registry("client")
 _TR = tracing.tracer("client")
@@ -59,6 +59,18 @@ class HdrfClient:
         if self.config.use_delegation_tokens:
             self._dtoken = self._nn.call("get_delegation_token",
                                          renewer=self.name, owner=self.name)
+
+    def _op_deadline(self):
+        """End-to-end budget for one public op: binds the ambient deadline
+        (propagated hop-by-hop as the _deadline header by RpcClient and
+        dt.send_op) when ``ClientConfig.op_deadline_s`` is set; otherwise a
+        no-op that leaves any caller-bound deadline in place."""
+        import contextlib as _ctx
+
+        b = self.config.op_deadline_s
+        if not b:
+            return _ctx.nullcontext()
+        return retry.bind(retry.Deadline(float(b)))
 
     def _call(self, method: str, **kw):
         """NameNode RPC with the client's delegation token and caller
@@ -347,7 +359,7 @@ class HdrfClient:
         """Write a whole file (the put path, §3.1 of SURVEY.md).  ``ec`` is an
         erasure-coding policy name ('rs-6-3-64k'): the file is cell-striped
         over k+m DataNodes instead of replicated (client/striped.py)."""
-        with _TR.span("write") as sp:
+        with self._op_deadline(), _TR.span("write") as sp:
             sp.annotate("path", path)
             sp.annotate("bytes", len(data))
             if ec is not None:
@@ -426,21 +438,33 @@ class HdrfClient:
     def _complete(self, path: str, lengths: dict[int, int],
                   timeout: float = 30.0) -> None:
         """completeFile retry loop: the NN answers False until every block
-        has a reported location (IBRs are asynchronous)."""
+        has a reported location (IBRs are asynchronous).  Polls under a
+        retry.Deadline — clamped by any ambient op budget."""
         import time as _t
 
-        deadline = _t.monotonic() + timeout
+        dl = retry.Deadline(retry.effective_budget(timeout))
         while True:
             if self._call("complete", path=path, client=self.name,
                              block_lengths=lengths):
                 return
-            if _t.monotonic() > deadline:
+            if dl.expired:
                 raise IOError(f"complete({path}) timed out awaiting replicas")
-            _t.sleep(0.05)
+            _t.sleep(min(0.05, max(dl.remaining(), 0.0)))
 
     def _write_block(self, path: str, block: bytes, retries: int = 3) -> int:
+        """Block-granular pipeline recovery with capped full-jitter backoff
+        between attempts (replacing the immediate hot-loop retry — the
+        DataStreamer's sleepy recovery, DataStreamer.java:655); a spent
+        ambient deadline stops retrying instead of sleeping into it."""
+        import time as _t
+
         last_err: Exception | None = None
-        for _ in range(retries):
+        delays = retry.backoff_delays(max(0, retries - 1),
+                                      base_s=0.05, cap_s=2.0)
+        for attempt in range(retries):
+            dl = retry.current()
+            if dl is not None:
+                dl.check("block write retry")
             alloc = self._call("add_block", path=path, client=self.name)
             bid = alloc["block_id"]
             try:
@@ -451,11 +475,18 @@ class HdrfClient:
                 _M.incr("block_write_retries")
                 self._call("abandon_block", path=path, client=self.name,
                               block_id=bid)
+            if attempt < retries - 1:
+                delay = next(delays)
+                if dl is not None:
+                    delay = min(delay, dl.remaining())
+                if delay > 0:
+                    _t.sleep(delay)
         raise IOError(f"block write failed after {retries} attempts: {last_err}")
 
     def _stream_block(self, alloc: dict, block: bytes) -> None:
         targets = alloc["targets"]
-        sock = socket.create_connection(tuple(targets[0]["addr"]), timeout=120)
+        sock = socket.create_connection(tuple(targets[0]["addr"]),
+                                        timeout=retry.effective_budget(120.0))
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock = dt.secure_socket(sock, alloc.get("token"),
@@ -478,7 +509,7 @@ class HdrfClient:
 
     def read(self, path: str, offset: int = 0, length: int = -1) -> bytes:
         """Read [offset, offset+length) of a file (whole file by default)."""
-        with _TR.span("read") as sp:
+        with self._op_deadline(), _TR.span("read") as sp:
             sp.annotate("path", path)
             loc = self._call("get_block_locations", path=path)
             total = loc["length"]
@@ -660,7 +691,8 @@ class HdrfClient:
 
     def _read_from(self, addr: tuple[str, int], block_id: int, offset: int,
                    length: int, token: dict | None = None) -> bytes:
-        sock = socket.create_connection(addr, timeout=120)
+        sock = socket.create_connection(addr,
+                                        timeout=retry.effective_budget(120.0))
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock = dt.secure_socket(sock, token,

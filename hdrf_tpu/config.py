@@ -87,6 +87,27 @@ class ReductionConfig:
     # deployment shape (BASELINE.json; bytes land in the worker's HBM as
     # they stream).  None = in-process compute via ``backend``.
     worker_addr: list | None = None
+    # Per-op worker deadline budget: base seconds + a per-MiB term scaled by
+    # payload size (replaces the reference's fixed 600 s socket timeout —
+    # DataNode.java:436 ``socketTimeout`` has no payload awareness).  A hung
+    # worker costs at most this budget before the DN falls back to the
+    # in-process codec.  Generous defaults: the dev VM's write-burst
+    # throttling stalls transports ~35 s (PERF_NOTES.md round 4).
+    worker_deadline_s: float = 120.0
+    worker_deadline_s_per_mb: float = 2.0
+    # DN->worker circuit breaker: open after N consecutive WORKER failures
+    # (caller-side iterator errors never count), half-open probe after
+    # reset_s, re-close on probe success.  While open, writes skip the
+    # connect entirely and reduce in-process (degraded passthrough).
+    worker_breaker_failures: int = 3
+    worker_breaker_reset_s: float = 10.0
+    # DN-side worker supervision: when True the DN spawns its own
+    # co-located reduction worker (spawn_local_worker) and respawns it
+    # with capped backoff if it dies; worker_addr then names the LIVE
+    # address and is updated on each respawn.
+    worker_spawn: bool = False
+    worker_respawn_base_s: float = 0.5
+    worker_respawn_cap_s: float = 15.0
     # Device read path: reconstruction-heavy reads gather chunks from
     # HBM-resident container images (ops/reconstruct.py).  Default OFF:
     # it wins on PCIe/DMA-attached chips where repeat reads amortize the
@@ -234,6 +255,11 @@ class ClientConfig:
     # Fetch a delegation token at connect and attach it to every NameNode
     # RPC (the kerberos-bootstrapped token flow, minus kerberos).
     use_delegation_tokens: bool = False
+    # End-to-end deadline budget (seconds) bound around each write/read op
+    # and propagated hop-by-hop as the _deadline header (utils/retry.py).
+    # None = no client-imposed budget (default: the dev VM's write-burst
+    # throttling stalls ~35 s, so budgets are strictly opt-in).
+    op_deadline_s: float | None = None
 
 
 @dataclass

@@ -114,6 +114,20 @@ def _selftest(mb: int, n_data: int) -> bool:
     return ok
 
 
+def supervised_worker(backend: str = "auto"):
+    """Spawn a supervised co-located reduction worker on this host (the
+    per-host daemon bring-up role of the reference's ``hdfs --daemon``
+    scripts, with the supervision the reference leaves to init systems):
+    returns the started WorkerSupervisor — the worker is respawned with
+    capped backoff if it dies, and ``supervisor.addr`` always names the
+    live incarnation."""
+    from hdrf_tpu.server.reduction_worker import WorkerSupervisor
+
+    sup = WorkerSupervisor(backend=backend)
+    sup.start()
+    return sup
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="hdrf-launch")
     ap.add_argument("--coordinator", default=None,
@@ -125,17 +139,31 @@ def main(argv=None) -> int:
     ap.add_argument("--selftest", type=int, default=0, metavar="MB",
                     help="reduce a seeded MB-sized block and verify "
                          "against the native oracle")
+    ap.add_argument("--with-worker", action="store_true",
+                    help="also spawn a SUPERVISED co-located reduction "
+                         "worker on this host (auto-respawn on death)")
     args = ap.parse_args(argv)
     initialize(args.coordinator, args.nprocs, args.rank)
-    if args.selftest:
-        return 0 if _selftest(args.selftest, args.n_data) else 1
     from hdrf_tpu.utils import log
 
-    log.get_logger("launch", stream=sys.stdout).info(
-        f"rank {jax.process_index()}/{jax.process_count()} up; "
-        f"{jax.local_device_count()} local / {jax.device_count()} "
-        f"global devices", rank=jax.process_index())
-    return 0
+    logger = log.get_logger("launch", stream=sys.stdout)
+    sup = None
+    if args.with_worker:
+        sup = supervised_worker()
+        logger.info(
+            f"supervised reduction worker listening on "
+            f"{sup.addr[0]}:{sup.addr[1]}", rank=jax.process_index())
+    try:
+        if args.selftest:
+            return 0 if _selftest(args.selftest, args.n_data) else 1
+        logger.info(
+            f"rank {jax.process_index()}/{jax.process_count()} up; "
+            f"{jax.local_device_count()} local / {jax.device_count()} "
+            f"global devices", rank=jax.process_index())
+        return 0
+    finally:
+        if sup is not None:
+            sup.stop()
 
 
 if __name__ == "__main__":

@@ -32,7 +32,7 @@ from typing import Any, Iterator
 
 from hdrf_tpu import native
 from hdrf_tpu.proto.rpc import recv_exact, recv_frame, send_frame
-from hdrf_tpu.utils import tracing
+from hdrf_tpu.utils import retry, tracing
 
 PKT_HDR = struct.Struct("<IQBI")
 FLAG_LAST = 0x1
@@ -75,6 +75,11 @@ def send_op(sock: socket.socket, op: str, **fields: Any) -> None:
     tr = tracing.current_context()
     if tr is not None:
         fields["_trace"] = list(tr)
+    # remaining deadline budget rides the op header beside _trace (the
+    # receiving DN rebinds it around its handler — datanode._xceive)
+    hdr = retry.remaining_header()
+    if hdr is not None:
+        fields[retry.DEADLINE_KEY] = hdr
     send_frame(sock, [op, fields])
 
 

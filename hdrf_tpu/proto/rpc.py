@@ -25,7 +25,7 @@ from typing import Any
 
 import msgpack
 
-from hdrf_tpu.utils import metrics, tracing
+from hdrf_tpu.utils import metrics, retry, tracing
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
@@ -125,6 +125,16 @@ class RpcServer:
         trace = kwargs.pop("_trace", None)
         retry_id = kwargs.pop("_retry_id", None)
         dtoken = kwargs.pop("_dtoken", None)
+        # Hop-by-hop deadline budget (remaining seconds, riding beside
+        # _trace): a request arriving with a spent budget is refused
+        # BEFORE dispatch — the caller already gave up, so running the
+        # handler would only waste the server's cycles.
+        deadline_hdr = kwargs.pop(retry.DEADLINE_KEY, None)
+        if deadline_hdr is not None and float(deadline_hdr) <= 0:
+            self._metrics.incr(f"{method}_deadline_rejected")
+            return [req_id, 1, {"error": "DeadlineExceeded",
+                                "message": f"{method}: deadline budget "
+                                           "exhausted before dispatch"}]
         # Caller identity (UGI analog): populated into a per-thread context
         # the service's permission checker reads.  Only set for WIRE calls —
         # in-process invocations act as the superuser, like the reference's
@@ -151,7 +161,7 @@ class RpcServer:
                 return [req_id, *cached]
         track = (self._watchdog.track(f"rpc.{method}")
                  if self._watchdog is not None else _null_ctx())
-        with track, \
+        with retry.bind_remaining(deadline_hdr), track, \
                 self._tracer.span(method,
                                   parent=tuple(trace) if trace else None):
             try:
@@ -238,10 +248,19 @@ class HaRpcClient:
 
         kwargs["_retry_id"] = _uuid.uuid4().hex
         last: Exception | None = None
-        for attempt in range(2 * len(self._clients)):
+        attempts = 2 * len(self._clients)
+        # second lap onward: capped full-jitter backoff instead of a fixed
+        # beat, so a thundering herd of proxies doesn't re-poll in lockstep
+        delays = retry.backoff_delays(attempts, base_s=0.1, cap_s=2.0)
+        for attempt in range(attempts):
+            dl = retry.current()
+            if dl is not None:
+                dl.check("namenode failover")  # spent budget: stop retrying
             c = self._clients[self._cur]
             try:
                 return c.call(method, **kwargs)
+            except retry.DeadlineExceeded:
+                raise
             except (ConnectionError, OSError) as e:
                 last = e
             except RpcError as e:
@@ -252,7 +271,11 @@ class HaRpcClient:
             if attempt >= len(self._clients):
                 import time as _t
 
-                _t.sleep(0.2)  # second lap: give a failover a beat to land
+                delay = next(delays)
+                if dl is not None:
+                    delay = min(delay, dl.remaining())
+                if delay > 0:
+                    _t.sleep(delay)
         raise ConnectionError(f"all namenodes failed: {last}")
 
     def close(self) -> None:
@@ -278,7 +301,8 @@ class RpcClient:
         self._req_id = 0
 
     def _connect(self) -> socket.socket:
-        s = socket.create_connection(self._addr, timeout=self._timeout)
+        s = socket.create_connection(
+            self._addr, timeout=retry.effective_budget(self._timeout))
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
@@ -286,12 +310,22 @@ class RpcClient:
         tr = tracing.current_context()
         if tr is not None:
             kwargs["_trace"] = list(tr)
+        # Ambient deadline: refuse a spent budget before touching the
+        # socket, stamp the remaining seconds as the hop-by-hop header,
+        # and clamp this call's socket timeout to the remainder.
+        dl = retry.current()
+        if dl is not None:
+            dl.check(f"rpc {method}")
+            kwargs[retry.DEADLINE_KEY] = dl.header()
         with self._lock:
             self._req_id += 1
             req_id = self._req_id
             try:
                 if self._sock is None:
                     self._sock = self._connect()
+                self._sock.settimeout(
+                    dl.timeout(self._timeout) if dl is not None
+                    else self._timeout)
                 send_frame(self._sock, [req_id, method, kwargs])
                 resp = recv_frame(self._sock)
             except (ConnectionError, OSError):

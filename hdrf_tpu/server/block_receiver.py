@@ -34,13 +34,14 @@ from typing import TYPE_CHECKING
 from hdrf_tpu import native
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import recv_frame, send_frame
-from hdrf_tpu.utils import fault_injection, metrics, tracing
+from hdrf_tpu.utils import fault_injection, log, metrics, retry, tracing
 
 if TYPE_CHECKING:
     from hdrf_tpu.server.datanode import DataNode
 
 _M = metrics.registry("block_receiver")
 _TR = tracing.tracer("datanode")
+_LOG = log.get_logger("block_receiver")
 
 
 def _checksums(data: bytes, chunk: int) -> list[int]:
@@ -52,7 +53,10 @@ def _connect(addr: list | tuple, dn=None, block_id: int | None = None,
     """Mirror-leg socket; encrypts when this DN is configured to (the
     reference's DN->DN SASL legs — tokens minted from the shared block keys
     when the incoming op's token isn't reusable)."""
-    s = socket.create_connection((addr[0], addr[1]), timeout=60)
+    # connect timeout clamped by the ambient deadline budget (a mirror
+    # leg may never outlive what's left of the end-to-end write budget)
+    s = socket.create_connection((addr[0], addr[1]),
+                                 timeout=retry.effective_budget(60.0))
     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     if dn is not None and dn.config.encrypt_data_transfer:
         if not token or not token.get("sig"):
@@ -234,6 +238,13 @@ class BlockReceiver:
             def stream():
                 for seqno, data, last in packets:
                     last_seqno[0] = seqno
+                    # same per-packet crash window as the direct path (the
+                    # resilience fault matrix kills the worker mid-stream
+                    # from here); a RAISING handler aborts the write like
+                    # any other client-stream error
+                    fault_injection.point("block_receiver.packet",
+                                          block_id=block_id, seqno=seqno,
+                                          dn_id=dn.dn_id)
                     # ack (flow control) and buffer BEFORE yielding: a
                     # consumer abandoning the generator mid-yield (worker
                     # death) must lose neither the ack nor the bytes
@@ -255,11 +266,18 @@ class BlockReceiver:
                     precomputed = dn.reduction_ctx.worker.reduce_stream(
                         stream(), dn.reduction_ctx.config.cdc)
                     _M.incr("worker_reduces")
-                except WorkerError:
-                    # WORKER failed (client-stream errors propagate as
-                    # their own types and abort the write as before):
-                    # drain the remaining packets and compute in-process
+                except (WorkerError, retry.DeadlineExceeded) as e:
+                    # WORKER failed, hung past its deadline budget, or its
+                    # breaker is open (zero-cost refusal) — client-stream
+                    # errors propagate as their own types and abort the
+                    # write as before.  Degraded mode: drain the remaining
+                    # packets and compute in-process (passthrough).
                     _M.incr("worker_fallbacks")
+                    _M.incr("degraded_writes")
+                    _LOG.warning("worker reduce failed; degraded write",
+                                 dn_id=dn.dn_id, block_id=block_id,
+                                 trace=tracing.current_context(),
+                                 error=f"{type(e).__name__}: {e}")
                     worker_down = True
                     for _ in stream():
                         pass
@@ -311,7 +329,15 @@ class BlockReceiver:
             if stored:
                 writer.write(stored)
             meta = writer.finalize(len(data), scheme_name, crcs, dn.checksum_chunk)
-        except Exception:
+        except (OSError, ValueError) as e:
+            # storage-layer failure (disk IO / corrupt state): clean up the
+            # rbw, log with the active trace, and re-raise — the xceiver
+            # accounts it.  Anything else propagates with the rbw left for
+            # the startup recovery scan (no silent broad catch).
+            _LOG.error("reduced store failed", dn_id=dn.dn_id,
+                       block_id=block_id,
+                       trace=tracing.current_context(),
+                       error=f"{type(e).__name__}: {e}")
             if dn._crashed:
                 writer.detach()   # crash sim: dead processes delete nothing
             else:
@@ -323,12 +349,26 @@ class BlockReceiver:
             try:
                 self.push_reduced(block_id, gen_stamp, scheme_name, len(data),
                                   stored, crcs, targets)
-            except (OSError, ConnectionError):
+            except (OSError, ConnectionError, retry.DeadlineExceeded) as e:
                 # Mirror failed; local copy is durable — the NN's redundancy
                 # monitor re-replicates (§3.5).  Matches pipeline-recovery
                 # semantics: report success for the local replica.
-                _M.incr("mirror_failures")
+                self._note_mirror_failure(targets[0], block_id, e)
         return status
+
+    def _note_mirror_failure(self, target: dict, block_id: int,
+                             e: BaseException) -> None:
+        """Outright mirror-leg failure: per-peer attribution rides the
+        next heartbeat (DataNode.note_mirror_failure) so the NN's outlier
+        detector flags BROKEN mirrors, not just slow ones."""
+        _M.incr("mirror_failures")
+        dn_id = target.get("dn_id")
+        if dn_id:
+            self._dn.note_mirror_failure(dn_id)
+        _LOG.warning("mirror push failed", dn_id=self._dn.dn_id,
+                     peer=dn_id, block_id=block_id,
+                     trace=tracing.current_context(),
+                     error=f"{type(e).__name__}: {e}")
 
     # -------------------------------------------- reduced mirroring (push side)
 
@@ -428,7 +468,13 @@ class BlockReceiver:
             if stored:
                 writer.write(stored)
             meta = writer.finalize(logical_len, scheme_name, list(crcs), cchunk)
-        except Exception:
+        except (OSError, ValueError) as e:
+            # same contract as _store_and_mirror: typed cleanup + traced
+            # log + re-raise (no silent broad catch)
+            _LOG.error("reduced ingest failed", dn_id=dn.dn_id,
+                       block_id=block_id,
+                       trace=tracing.current_context(),
+                       error=f"{type(e).__name__}: {e}")
             if dn._crashed:
                 writer.detach()   # crash sim: dead processes delete nothing
             else:
@@ -440,7 +486,7 @@ class BlockReceiver:
             try:
                 self.push_reduced(block_id, gen_stamp, scheme_name, logical_len,
                                   stored, list(crcs), targets)
-            except (OSError, ConnectionError):
-                _M.incr("mirror_failures")
+            except (OSError, ConnectionError, retry.DeadlineExceeded) as e:
+                self._note_mirror_failure(targets[0], block_id, e)
         dt.send_ack(sock, 0, status)
         _M.incr("blocks_ingested_reduced")

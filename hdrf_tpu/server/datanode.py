@@ -43,7 +43,7 @@ from hdrf_tpu.server.block_sender import BlockSender
 from hdrf_tpu.server.status_http import StatusHttpServer
 from hdrf_tpu.reduction import accounting
 from hdrf_tpu.utils import (device_ledger, fault_injection, log, metrics,
-                            rollwin, tracing)
+                            retry, rollwin, tracing)
 from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("datanode")
@@ -116,6 +116,10 @@ class DataNode:
         standby needs block reports too, so its block map is warm at
         failover) but executes commands only from the active."""
         self.config = config
+        # dn_id is fixed BEFORE the worker wiring below: the DN->worker
+        # circuit breaker is registered per edge as "<dn_id>->worker", so
+        # MiniCluster DNs sharing one worker address get SEPARATE breakers.
+        self.dn_id = dn_id or f"dn-{uuid.uuid4().hex[:8]}"
         self.checksum_chunk = 64 * 1024
         # background-transfer cap (DataTransferThrottler analog): balancer
         # moves, re-replication, EC reconstruction — never client pipelines
@@ -138,18 +142,41 @@ class DataNode:
         # device-free, falling back to the host codec if it dies), else
         # the in-process TPU path, else the host codec default.
         self._worker = None
+        self._worker_breaker = None
+        self._worker_supervisor = None
         seal_fn = None
         seal_batch_fn = None
+        if red.worker_spawn and not red.worker_addr:
+            # Supervised co-located worker: the DN owns the process and
+            # respawns it with backoff; each respawn repoints the client
+            # (fresh ephemeral port) and the breaker's half-open probe
+            # re-admits the edge.
+            from hdrf_tpu.server.reduction_worker import WorkerSupervisor
+
+            self._worker_supervisor = WorkerSupervisor(
+                backend=red.backend,
+                base_s=red.worker_respawn_base_s,
+                cap_s=red.worker_respawn_cap_s,
+                on_respawn=lambda addr: self._worker.set_addr(addr))
+            red.worker_addr = list(self._worker_supervisor.start())
         if red.worker_addr:
             from hdrf_tpu.server.reduction_worker import (WorkerClient,
                                                           WorkerError)
 
-            self._worker = WorkerClient(tuple(red.worker_addr))
+            self._worker_breaker = retry.breaker(
+                f"{self.dn_id}->worker",
+                failure_threshold=red.worker_breaker_failures,
+                reset_s=red.worker_breaker_reset_s)
+            self._worker = WorkerClient(
+                tuple(red.worker_addr),
+                deadline_s=red.worker_deadline_s,
+                deadline_s_per_mb=red.worker_deadline_s_per_mb,
+                breaker=self._worker_breaker)
 
             def _worker_seal(data: bytes) -> bytes:
                 try:
                     return self._worker.compress("lz4", data)
-                except WorkerError:
+                except (WorkerError, retry.DeadlineExceeded):
                     _M.incr("worker_fallbacks")
                     from hdrf_tpu.utils import codec as codecs
 
@@ -158,7 +185,7 @@ class DataNode:
             def _worker_seal_batch(datas: list) -> list:
                 try:
                     return self._worker.compress_batch("lz4", datas)
-                except WorkerError:
+                except (WorkerError, retry.DeadlineExceeded):
                     _M.incr("worker_fallbacks")
                     from hdrf_tpu.utils import codec as codecs
 
@@ -217,7 +244,6 @@ class DataNode:
         self.aliasmap = InMemoryAliasMap(
             os.path.join(config.data_dir, "aliasmap"),
             mount_root=config.provided_mount_root or None)
-        self.dn_id = dn_id or f"dn-{uuid.uuid4().hex[:8]}"
         from hdrf_tpu.proto.rpc import normalize_addrs
 
         # Federation (BPOfferService.java:57 per namespace): accept either
@@ -251,6 +277,10 @@ class DataNode:
         # (DataNodeVolumeMetrics analog).  Both ride heartbeats to the NN.
         self._peer_win = rollwin.WindowMap(window_s=300.0, maxlen=64)
         self._vol_win = rollwin.WindowMap(window_s=300.0, maxlen=64)
+        # outright mirror failures per peer (vs merely slow ones above);
+        # cumulative counts, shipped in every heartbeat's stats
+        self._mirror_fail: dict[str, int] = {}
+        self._mirror_fail_lock = threading.Lock()
         self._log = log.get_logger("datanode")
         import time as _time
         # lifeline trigger clocks, PER NN (the reference's lifeline is
@@ -380,6 +410,8 @@ class DataNode:
             t.join(timeout=5)
         self.containers.flush_open(on_seal=self.index.seal_container)
         self.index.close()
+        if self._worker_supervisor is not None:
+            self._worker_supervisor.stop()
         if self._worker is not None:
             self._worker.close()
         for nn in self._nns:
@@ -508,7 +540,8 @@ class DataNode:
                 # OUTSIDE the xceiver span so polling never pollutes traces.
                 self._serve_trace_spans(sock)
                 return
-            with self.watchdog.track(f"xceiver.{op}"), \
+            with retry.bind_remaining(fields.get(retry.DEADLINE_KEY)), \
+                    self.watchdog.track(f"xceiver.{op}"), \
                     _TR.span(f"xceiver.{op}",
                              parent=tuple(trace) if trace else None) as sp:
                 sp.annotate("dn_id", self.dn_id)
@@ -527,12 +560,20 @@ class DataNode:
                "spans": tracing.all_span_snapshots(),
                "ledger": device_ledger.events_snapshot()}
         if self._worker is not None:
+            from hdrf_tpu.server.reduction_worker import WorkerError
+
             try:
                 w = self._worker.traces()
                 out["spans"] = out["spans"] + list(w.get("spans") or ())
                 out["ledger"] = out["ledger"] + list(w.get("ledger") or ())
-            except Exception:  # worker down: local view still serves
+            except (WorkerError, ConnectionError, OSError,
+                    retry.DeadlineExceeded) as e:
+                # worker down: local view still serves
                 _M.incr("worker_trace_failures")
+                self._log.warning("worker trace poll failed",
+                                     dn_id=self.dn_id,
+                                     trace=tracing.current_context(),
+                                     error=f"{type(e).__name__}: {e}")
         send_frame(sock, out)
 
     def _dispatch_op(self, sock: socket.socket, op, fields: dict) -> None:
@@ -742,6 +783,21 @@ class DataNode:
     def note_peer_latency(self, dn_id: str, s_per_mb: float) -> None:
         self._peer_win.note(dn_id, s_per_mb)
 
+    def note_mirror_failure(self, dn_id: str) -> None:
+        """A pipeline mirror to ``dn_id`` failed outright (vs merely slow):
+        counted per peer and shipped in the next heartbeat's stats so the
+        NN's outlier detector sees BROKEN mirrors within two heartbeats."""
+        with self._mirror_fail_lock:
+            self._mirror_fail[dn_id] = self._mirror_fail.get(dn_id, 0) + 1
+
+    @property
+    def reduction_degraded(self) -> bool:
+        """True while the DN->worker edge is not fully admitted (breaker
+        open or probing): writes still succeed via in-process passthrough,
+        but the node is running without its co-located reduction worker."""
+        return (self._worker_breaker is not None
+                and self._worker_breaker.state != "closed")
+
     def note_volume_latency(self, vol_id: int, seconds: float) -> None:
         """Disk-probe / IO duration sample for slow-volume detection
         (DataNodeVolumeMetrics feeding SlowDiskTracker)."""
@@ -793,7 +849,11 @@ class DataNode:
         }
 
     def _stats(self) -> dict:
+        with self._mirror_fail_lock:
+            mirror_failures = dict(self._mirror_fail)
         return {
+            "reduction_degraded": self.reduction_degraded,
+            "mirror_failures": mirror_failures,
             "peer_transfer": self._peer_report(),
             "volumes": self._volume_report(),
             "reduction": self._reduction_report(),

@@ -450,9 +450,11 @@ class HttpGateway:
             _LOG.warning("health probe: namenode unreachable",
                          namenode=str(self._nn_addr))
             return {"status": "unreachable", "namenode": str(self._nn_addr)}
+        degraded_nodes = slow.get("degraded_nodes") or []
         degraded = (cluster["dead"] > 0 or cluster["safemode"]
                     or cluster["under_replicated"] > 0
-                    or slow["slow_peers"] or slow["slow_volumes"])
+                    or slow["slow_peers"] or slow["slow_volumes"]
+                    or bool(degraded_nodes))
         return {"status": "degraded" if degraded else "healthy",
                 "role": cluster["role"],
                 "safemode": cluster["safemode"],
@@ -461,6 +463,10 @@ class HttpGateway:
                 "under_replicated": cluster["under_replicated"],
                 "slow_peers": slow["slow_peers"],
                 "slow_volumes": slow["slow_volumes"],
+                # DNs running passthrough (worker breaker open/probing):
+                # writes succeed but reduction is off on these nodes
+                "degraded_nodes": degraded_nodes,
+                "mirror_failures": slow.get("mirror_failures") or {},
                 "dedup_ratio": cluster["dedup_ratio"],
                 "dedup_logical_bytes": cluster["dedup_logical_bytes"],
                 "dedup_unique_bytes": cluster["dedup_unique_bytes"]}

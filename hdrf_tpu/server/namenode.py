@@ -2742,6 +2742,8 @@ class NameNode:
                 "dedup_ratio": _acc.dedup_ratio(ded_logical, ded_unique),
                 "slow_peers": len(health["slow_peers"]),
                 "slow_volumes": len(health["slow_volumes"]),
+                "reduction_degraded": len(health["degraded_nodes"]),
+                "degraded_nodes": health["degraded_nodes"],
                 "editlog_seq": self._editlog.seq,
                 "journal_addrs": [list(a) for a in
                                   (self.config.journal_addrs or [])],
@@ -2891,6 +2893,8 @@ class NameNode:
 
         peers: dict[str, list[float]] = {}
         vols: dict[str, float] = {}
+        mirror_failures: dict[str, int] = {}
+        degraded: list[str] = []
         for dn in self._datanodes.values():
             st = dn.stats or {}
             for peer, rep in (st.get("peer_transfer") or {}).items():
@@ -2899,17 +2903,35 @@ class NameNode:
                 pm = v.get("probe_median_s")
                 if pm is not None and not v.get("failed"):
                     vols[f"{dn.dn_id}:vol-{vid}"] = float(pm)
+            # outright mirror failures per peer (block_receiver attribution
+            # riding heartbeats): summed across reporters
+            for peer, n in (st.get("mirror_failures") or {}).items():
+                mirror_failures[peer] = mirror_failures.get(peer, 0) + int(n)
+            # reduction_degraded: the DN's worker breaker is not closed —
+            # writes succeed via passthrough but reduction is off
+            if st.get("reduction_degraded"):
+                degraded.append(dn.dn_id)
         peer_meds = {p: statistics.median(ms) for p, ms in peers.items()}
         slow_peers = outlier.detect(
             peer_meds, abs_floor=self.SLOW_PEER_FLOOR_S_PER_MB)
+        # a peer with outright mirror failures is flagged even when its
+        # latency median looks fine (broken beats slow) — within two
+        # heartbeats of the failure: one to ship the count, one to read it
+        for peer, n in mirror_failures.items():
+            if peer not in slow_peers:
+                slow_peers[peer] = {"rule": "mirror_failure"}
+            slow_peers[peer]["mirror_failures"] = n
         slow_vols = outlier.detect(
             vols, abs_floor=self.SLOW_VOLUME_FLOOR_S)
         _M.gauge("slow_peer_count", len(slow_peers))
         _M.gauge("slow_volume_count", len(slow_vols))
+        _M.gauge("reduction_degraded_count", len(degraded))
         return {"slow_peers": slow_peers,
                 "slow_volumes": slow_vols,
                 "peer_medians_s_per_mb": peer_meds,
                 "volume_probe_medians_s": vols,
+                "mirror_failures": mirror_failures,
+                "degraded_nodes": sorted(degraded),
                 "reporters": {p: len(ms) for p, ms in peers.items()}}
 
     def rpc_slow_nodes_report(self) -> dict:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -37,7 +38,7 @@ from hdrf_tpu.config import CdcConfig
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import recv_frame, send_frame
 from hdrf_tpu.reduction import accounting
-from hdrf_tpu.utils import metrics, tracing
+from hdrf_tpu.utils import metrics, retry, tracing
 
 _M = metrics.registry("reduction_worker")
 _TR = tracing.tracer("reduction_worker")
@@ -113,7 +114,11 @@ class ReductionWorker:
         trace = req.get("_trace")
         try:
             if op in ("reduce", "compress", "compress_batch"):
-                with self.watchdog.track(f"worker.{op}"), \
+                # Rebind the DN's remaining deadline budget (hop-by-hop,
+                # same transport slot as _trace) so worker-side sub-calls
+                # inherit what's left of the end-to-end budget.
+                with retry.bind_remaining(req.get(retry.DEADLINE_KEY)), \
+                        self.watchdog.track(f"worker.{op}"), \
                         _TR.span(f"worker.{op}",
                                  parent=tuple(trace) if trace else None) as sp:
                     sp.annotate("backend", self.backend)
@@ -265,22 +270,71 @@ class WorkerError(IOError):
 class WorkerClient:
     """DN-side handle on the co-located worker.  One pooled connection per
     concurrent job (connections are cheap on loopback; the pool bound comes
-    from the DN's admission slots holding across the round trip)."""
+    from the DN's admission slots holding across the round trip).
 
-    def __init__(self, addr, timeout: float = 600.0):
+    Resilience contract (utils/retry.py): every data-path op runs under a
+    payload-scaled deadline budget — ``deadline_s`` base plus
+    ``deadline_s_per_mb`` accrued per streamed MiB, clamped by any ambient
+    end-to-end deadline — so a HUNG worker costs at most the remaining
+    budget, not the reference's fixed 600 s socket timeout.  When a
+    ``breaker`` (retry.CircuitBreaker) is attached, data-path ops check it
+    BEFORE connecting: a DEAD worker costs zero connect attempts while the
+    breaker is open, and the half-open probe re-admits the edge when the
+    worker returns.  Worker-side failures record breaker outcomes; errors
+    from the caller's own packet iterator never touch the breaker (they
+    are not evidence about the worker).  ping/stats/traces stay outside
+    the breaker so observability polls never consume the half-open probe.
+    """
+
+    def __init__(self, addr, timeout: float = 600.0,
+                 deadline_s: float | None = None,
+                 deadline_s_per_mb: float = 0.0,
+                 breaker: "retry.CircuitBreaker | None" = None):
         self._addr = (addr[0], int(addr[1]))
-        self._timeout = timeout
+        self._timeout = timeout if deadline_s is None else deadline_s
+        self._per_mb = float(deadline_s_per_mb)
+        self._breaker = breaker
         self._pool: list[socket.socket] = []
         self._lock = threading.Lock()
 
-    def _conn(self) -> socket.socket:
+    def set_addr(self, addr) -> None:
+        """Repoint at a respawned worker (it lands on a fresh ephemeral
+        port); pooled connections to the old incarnation are dropped."""
+        with self._lock:
+            self._addr = (addr[0], int(addr[1]))
+            for s in self._pool:
+                s.close()
+            self._pool.clear()
+
+    def _deadline(self, nbytes: int = 0) -> retry.Deadline:
+        budget = self._timeout + self._per_mb * (nbytes / float(1 << 20))
+        return retry.Deadline(retry.effective_budget(budget))
+
+    def _conn(self, dl: retry.Deadline,
+              gated: bool = True) -> socket.socket:
+        if gated and self._breaker is not None \
+                and not self._breaker.allow():
+            e = WorkerError(
+                f"worker breaker '{self._breaker.name}' open: "
+                "skipping connect")
+            e.breaker_open = True  # not evidence of a NEW failure
+            raise e
         with self._lock:
             if self._pool:
-                return self._pool.pop()
+                s = self._pool.pop()
+                s.settimeout(dl.timeout())
+                return s
         try:
-            s = socket.create_connection(self._addr, timeout=self._timeout)
+            _M.incr("connect_attempts")
+            s = socket.create_connection(self._addr, timeout=dl.timeout())
         except OSError as e:
-            raise WorkerError(f"worker unreachable: {e}") from e
+            err = WorkerError(f"worker unreachable: {e}")
+            if gated:
+                # connect refusal is the clearest dead-worker evidence, and
+                # it raises BEFORE the callers' try/except-_fail blocks —
+                # record it here (ungated observability polls stay outside)
+                self._fail(err)
+            raise err from e
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
@@ -291,38 +345,54 @@ class WorkerClient:
                 return
         s.close()
 
+    def _ok(self) -> None:
+        if self._breaker is not None:
+            self._breaker.record_success()
+
+    def _fail(self, e: BaseException) -> None:
+        if self._breaker is not None \
+                and not getattr(e, "breaker_open", False):
+            self._breaker.record_failure()
+
     def _checked(self, resp: dict) -> dict:
         if "error" in resp:
             raise WorkerError(
                 f"worker: {resp['error']}: {resp['message']}")
         return resp
 
-    @staticmethod
-    def _traced(req: dict) -> dict:
-        """Stamp the caller's span context into the request frame (same
-        contract as dt.send_op headers / RpcClient.call), so the worker's
-        span nests under the DN pipeline span that drove it."""
+    def _stamped(self, req: dict,
+                 dl: "retry.Deadline | None" = None) -> dict:
+        """Stamp the caller's span context (and remaining deadline budget)
+        into the request frame (same contract as dt.send_op headers /
+        RpcClient.call), so the worker's span nests under the DN pipeline
+        span that drove it and its sub-calls inherit the budget."""
         tr = tracing.current_context()
         if tr is not None:
             req["_trace"] = list(tr)
+        hdr = dl.header() if dl is not None else retry.remaining_header()
+        if hdr is not None:
+            req[retry.DEADLINE_KEY] = hdr
         return req
 
     def reduce_stream(self, packets, cdc: CdcConfig):
         """Forward an iterator of byte packets; returns (cuts, digests).
         This is the true streaming path: the DN calls it from inside its
         packet-receive loop, so client->DN->worker->HBM is one pipeline.
+        The deadline budget accrues ``deadline_s_per_mb`` per streamed MiB
+        (payload size is only known as it arrives).
 
         Exception taxonomy: worker-side failures raise :class:`WorkerError`;
         anything the ``packets`` iterator itself raises (the caller's OWN
         stream — e.g. the DN's client connection dying) propagates
         unchanged, so the caller can tell the two apart."""
-        s = self._conn()
+        dl = self._deadline()
+        s = self._conn(dl)
         try:
             try:
-                send_frame(s, self._traced(
+                send_frame(s, self._stamped(
                     {"op": "reduce", "mask_bits": cdc.mask_bits,
                      "min_chunk": cdc.min_chunk,
-                     "max_chunk": cdc.max_chunk}))
+                     "max_chunk": cdc.max_chunk}, dl))
             except OSError as e:
                 raise WorkerError(f"worker send failed: {e}") from e
             seq = 0
@@ -335,11 +405,16 @@ class WorkerClient:
                 if not data:
                     continue
                 try:
+                    dl.extend(self._per_mb * len(data) / float(1 << 20))
+                    dl.check("worker reduce stream")
+                    s.settimeout(dl.timeout())
                     dt.write_packet(s, seq, data)
                 except OSError as e:
                     raise WorkerError(f"worker send failed: {e}") from e
                 seq += 1
             try:
+                dl.check("worker reduce")
+                s.settimeout(dl.timeout())
                 dt.write_packet(s, seq, b"", last=True)
                 resp = self._checked(recv_frame(s))
             except (OSError, ConnectionError) as e:
@@ -348,57 +423,72 @@ class WorkerClient:
             digs = np.frombuffer(resp["digests"],
                                  np.uint8).reshape(-1, 32)
             self._release(s)
+            self._ok()
             return cuts, digs
-        except BaseException:
+        except BaseException as e:
             s.close()
+            if isinstance(e, (WorkerError, retry.DeadlineExceeded)):
+                self._fail(e)
             raise
 
     def reduce(self, data: bytes, cdc: CdcConfig):
         return self.reduce_stream([data], cdc)
 
     def compress(self, codec: str, data: bytes) -> bytes:
-        s = self._conn()
+        dl = self._deadline(len(data))
+        s = self._conn(dl)
         try:
             try:
-                send_frame(s, self._traced({"op": "compress",
-                                            "codec": codec}))
+                send_frame(s, self._stamped({"op": "compress",
+                                             "codec": codec}, dl))
                 dt.stream_bytes(s, data, 1 << 20)
+                dl.check("worker compress")
+                s.settimeout(dl.timeout())
                 out = bytes(self._checked(recv_frame(s))["data"])
             except (OSError, ConnectionError) as e:
                 raise WorkerError(f"worker failed: {e}") from e
             self._release(s)
+            self._ok()
             return out
-        except BaseException:
+        except BaseException as e:
             s.close()
+            if isinstance(e, (WorkerError, retry.DeadlineExceeded)):
+                self._fail(e)
             raise
 
     def compress_batch(self, codec: str, datas: list) -> list:
         """Batched compress: one round trip, one worker-side device program
         for the group (see ReductionWorker._op_compress_batch)."""
-        s = self._conn()
+        dl = self._deadline(sum(len(d) for d in datas))
+        s = self._conn(dl)
         try:
             try:
-                send_frame(s, self._traced(
+                send_frame(s, self._stamped(
                     {"op": "compress_batch", "codec": codec,
-                     "sizes": [len(d) for d in datas]}))
+                     "sizes": [len(d) for d in datas]}, dl))
                 seq = 0
                 for d in datas:
                     if d:
                         dt.write_packet(s, seq, d)
                         seq += 1
                 dt.write_packet(s, seq, b"", last=True)
+                dl.check("worker compress_batch")
+                s.settimeout(dl.timeout())
                 outs = [bytes(v)
                         for v in self._checked(recv_frame(s))["datas"]]
             except (OSError, ConnectionError) as e:
                 raise WorkerError(f"worker failed: {e}") from e
             self._release(s)
+            self._ok()
             return outs
-        except BaseException:
+        except BaseException as e:
             s.close()
+            if isinstance(e, (WorkerError, retry.DeadlineExceeded)):
+                self._fail(e)
             raise
 
     def ping(self) -> dict:
-        s = self._conn()
+        s = self._conn(self._deadline(), gated=False)
         try:
             send_frame(s, {"op": "ping"})
             out = self._checked(recv_frame(s))
@@ -409,7 +499,7 @@ class WorkerClient:
             raise
 
     def stats(self) -> dict:
-        s = self._conn()
+        s = self._conn(self._deadline(), gated=False)
         try:
             send_frame(s, {"op": "stats"})
             out = self._checked(recv_frame(s))
@@ -422,7 +512,7 @@ class WorkerClient:
     def traces(self) -> dict:
         """Worker-process spans + device-ledger events (the DN proxies this
         through its own trace_spans op for the gateway merge)."""
-        s = self._conn()
+        s = self._conn(self._deadline(), gated=False)
         try:
             send_frame(s, {"op": "traces"})
             out = self._checked(recv_frame(s))
@@ -457,6 +547,98 @@ def spawn_local_worker(backend: str = "auto"):
         proc.terminate()
         raise RuntimeError(f"worker failed to start: {line!r}")
     return proc, (m.group(1), int(m.group(2)))
+
+
+class WorkerSupervisor:
+    """Supervised co-located worker: owns the process, detects death, and
+    respawns with capped full-jitter backoff (the NodeManager service-
+    restart role the reference delegates to init systems; DataNode.java has
+    no analog for its in-process codecs — they die with the daemon).
+
+    ``on_respawn(addr)`` fires after each successful respawn so the owner
+    repoints its :class:`WorkerClient` (`set_addr`) — respawned workers
+    land on a fresh ephemeral port.  Clock/sleep/spawn are injectable so
+    tests drive the respawn schedule without wall-clock waits.  A process
+    that stayed up longer than ``healthy_s`` resets the backoff streak.
+    """
+
+    def __init__(self, backend: str = "auto", base_s: float = 0.5,
+                 cap_s: float = 15.0, healthy_s: float = 30.0,
+                 on_respawn=None, clock=time.monotonic,
+                 sleep=time.sleep, spawn=spawn_local_worker,
+                 poll_s: float = 0.2):
+        self._backend = backend
+        self._base_s = float(base_s)
+        self._cap_s = float(cap_s)
+        self._healthy_s = float(healthy_s)
+        self._on_respawn = on_respawn
+        self._clock = clock
+        self._sleep = sleep
+        self._spawn = spawn
+        self._poll_s = float(poll_s)
+        self._proc = None
+        self.addr: tuple[str, int] | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._spawned_at = 0.0
+        self._streak = 0  # consecutive quick deaths
+        self.respawns = 0
+
+    def start(self) -> tuple[str, int]:
+        """Spawn the first incarnation and the monitor thread; returns the
+        worker address (startup failures propagate to the caller — only
+        RE-spawns are retried with backoff)."""
+        self._proc, self.addr = self._spawn(self._backend)
+        self._spawned_at = self._clock()
+        self._thread = threading.Thread(target=self._monitor,
+                                        name="worker-supervisor",
+                                        daemon=True)
+        self._thread.start()
+        return self.addr
+
+    def _monitor(self) -> None:
+        import random as _random
+
+        while not self._stop.is_set():
+            if self._proc.poll() is None:
+                self._sleep(self._poll_s)
+                continue
+            if self._stop.is_set():
+                return
+            if self._clock() - self._spawned_at >= self._healthy_s:
+                self._streak = 0
+            delay = _random.uniform(0.0, min(
+                self._cap_s, self._base_s * (2.0 ** self._streak)))
+            self._streak += 1
+            _M.incr("worker_deaths")
+            if delay > 0:
+                self._sleep(delay)
+            if self._stop.is_set():
+                return
+            try:
+                self._proc, self.addr = self._spawn(self._backend)
+            except Exception:
+                _M.incr("worker_respawn_failures")
+                continue  # next lap backs off further
+            self._spawned_at = self._clock()
+            self.respawns += 1
+            _M.incr("worker_respawns")
+            if self._on_respawn is not None:
+                try:
+                    self._on_respawn(self.addr)
+                except Exception:
+                    _M.incr("worker_respawn_callback_errors")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except Exception:
+                self._proc.kill()
 
 
 def main(argv=None) -> int:

@@ -32,7 +32,8 @@ class MiniCluster:
                  tpu_worker: bool = False,
                  worker_backend: str = "auto",
                  backend: str | None = None,
-                 dn_config_overrides: dict | None = None):
+                 dn_config_overrides: dict | None = None,
+                 reduction_overrides: dict | None = None):
         """``journal_nodes`` > 0 boots that many JournalNodes and puts the
         edit log on the quorum (MiniQJMHACluster analog); each NN then gets
         its OWN meta_dir (only the shared-dir deployment shares one).
@@ -54,6 +55,9 @@ class MiniCluster:
         # per-DN volume types (multi-volume DNs); applies to EVERY DN
         self.volume_types = volume_types
         self.dn_config_overrides = dn_config_overrides or {}
+        # knobs applied to every DN's cfg.reduction (deadline/breaker
+        # tuning for resilience tests)
+        self.reduction_overrides = reduction_overrides or {}
         self.tpu_worker = tpu_worker
         self.worker_backend = worker_backend
         self.backend = backend
@@ -179,6 +183,8 @@ class MiniCluster:
             cfg.volume_types = list(self.volume_types)
         for k, v in self.dn_config_overrides.items():
             setattr(cfg, k, v)
+        for k, v in self.reduction_overrides.items():
+            setattr(cfg.reduction, k, v)
         addr = (self.all_ns_addrs() if self.nameservices_n > 1
                 else self.nn_addrs())
         return DataNode(cfg, addr, dn_id=f"dn-{i}")
@@ -207,6 +213,11 @@ class MiniCluster:
             self._worker_proc.terminate()
             self._worker_proc.wait(timeout=5)
             self._worker_proc = None
+        # drop per-edge circuit breakers (process-wide registry): a breaker
+        # opened by THIS cluster's faults must not leak into the next test's
+        # identically-named dn-N edges
+        from hdrf_tpu.utils import retry
+        retry.reset_breakers()
         if self._own_dir:
             shutil.rmtree(self.base_dir, ignore_errors=True)
         # reclaim shm segments of RAM_DISK volumes rooted under base_dir
@@ -253,6 +264,30 @@ class MiniCluster:
             # before a restart may scan the same directory
             dn.await_xceivers()
             self.datanodes[i] = None
+
+    def kill_worker(self) -> None:
+        """SIGKILL the shared reduction worker (kill -9 simulation).  The
+        DNs keep its now-dead address: subsequent reduced writes hit
+        connection refusals, trip the per-DN worker breaker, and degrade
+        to in-process passthrough."""
+        assert self._worker_proc is not None, "no tpu_worker in this cluster"
+        self._worker_proc.kill()
+        self._worker_proc.wait(timeout=5)
+        self._worker_proc = None
+
+    def restart_worker(self) -> tuple:
+        """Boot a fresh reduction worker (new ephemeral port) and repoint
+        every live DN's WorkerClient at it — the out-of-band analog of
+        WorkerSupervisor.on_respawn for clusters that own the worker."""
+        from hdrf_tpu.server.reduction_worker import spawn_local_worker
+
+        self._worker_proc, self._worker_addr = spawn_local_worker(
+            backend=self.worker_backend)
+        for dn in self.datanodes:
+            if dn is not None and dn._worker is not None:
+                dn._worker.set_addr(tuple(self._worker_addr))
+                dn.config.reduction.worker_addr = list(self._worker_addr)
+        return tuple(self._worker_addr)
 
     def restart_namenode(self) -> NameNode:
         """Stop + boot the NameNode over the same meta dir AND the same port

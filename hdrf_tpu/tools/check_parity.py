@@ -1,4 +1,4 @@
-"""Parity-citation lint: every module must cite its reference sources.
+"""Parity-citation and fault-point lints.
 
 The repo convention (CLAUDE.md; e.g. the headers of server/datanode.py,
 reduction/dedup.py) is that each module's docstring names the reference
@@ -9,8 +9,14 @@ every ``hdrf_tpu/**/*.py`` module (``__init__.py`` exempt — package
 markers carry no component of their own) must have a docstring containing
 at least one such citation.
 
+It also lints the fault-injection matrix (the DataNodeFaultInjector.java:33
+mechanism re-expressed by utils/fault_injection.py): every
+``fault_injection.point("name", ...)`` declared in main code must be
+referenced by at least one test under ``tests/`` — an unexercised crash
+window is a crash window nobody has proven survivable.
+
 Run as ``python -m hdrf_tpu.tools.check_parity`` (exit 1 on violations);
-wired as a tier-1 test in tests/test_tools.py.
+wired as tier-1 tests in tests/test_tools.py.
 """
 
 from __future__ import annotations
@@ -25,6 +31,10 @@ CITATION = re.compile(
     r"[A-Za-z0-9_][A-Za-z0-9_.\-/]*"
     r"\.(?:java|py|c|cc|cpp|h|hpp|proto|md|html|sh|json)"
     r":\d+(?:-\d+)?")
+
+# fault_injection.point("name", ...) declarations in main code
+FAULT_POINT = re.compile(
+    r"fault_injection\.point\(\s*['\"]([A-Za-z0-9_.]+)['\"]")
 
 
 def check(root: str) -> list[str]:
@@ -50,15 +60,52 @@ def check(root: str) -> list[str]:
     return problems
 
 
+def declared_fault_points(root: str) -> dict[str, str]:
+    """Every fault point declared under ``root`` -> declaring file."""
+    points: dict[str, str] = {}
+    for dirpath, _dirs, files in sorted(os.walk(root)):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            src = open(path, encoding="utf-8").read()
+            for name in FAULT_POINT.findall(src):
+                points.setdefault(name,
+                                  os.path.relpath(path,
+                                                  os.path.dirname(root)))
+    return points
+
+
+def check_fault_points(root: str, tests_dir: str | None = None) -> list[str]:
+    """Return one message per declared-but-untested fault point."""
+    if tests_dir is None:
+        tests_dir = os.path.join(os.path.dirname(root), "tests")
+    corpus = []
+    if os.path.isdir(tests_dir):
+        for dirpath, _dirs, files in sorted(os.walk(tests_dir)):
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    corpus.append(
+                        open(os.path.join(dirpath, fn),
+                             encoding="utf-8").read())
+    corpus = "\n".join(corpus)
+    problems = []
+    for name, where in sorted(declared_fault_points(root).items()):
+        if f'"{name}"' not in corpus and f"'{name}'" not in corpus:
+            problems.append(f"fault point '{name}' ({where}) is referenced "
+                            f"by no test under {tests_dir}")
+    return problems
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     root = argv[0] if argv else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    problems = check(root)
+    problems = check(root) + check_fault_points(root)
     for p in problems:
         print(p)
     print(f"{len(problems)} violation(s)" if problems
-          else "parity citations: all modules cite references")
+          else "parity citations + fault-point coverage: clean")
     return 1 if problems else 0
 
 

@@ -260,18 +260,23 @@ def cmd_dfsadmin(args) -> int:
                   f"dedup_logical={cs['dedup_logical_bytes']} "
                   f"dedup_unique={cs['dedup_unique_bytes']}")
             print(f"Health: slow_peers={cs['slow_peers']} "
-                  f"slow_volumes={cs['slow_volumes']}")
+                  f"slow_volumes={cs['slow_volumes']} "
+                  f"reduction_degraded={cs.get('reduction_degraded', 0)}")
             for d in c.datanode_report():
                 state = "live" if d["alive"] else "dead"
                 stats = d.get("stats", {})
                 stalls = stats.get("stalls", 0)
                 vols = stats.get("volumes") or {}
                 failed = sum(1 for v in vols.values() if v.get("failed"))
+                # passthrough marker: the DN's worker breaker is open —
+                # writes land unreduced until the half-open probe re-closes
+                degraded = (" REDUCTION_DEGRADED"
+                            if stats.get("reduction_degraded") else "")
                 print(f"{d['dn_id']:>12} {state:>5} blocks={d['blocks']} "
                       f"logical={stats.get('logical_bytes', 0)} "
                       f"physical={stats.get('physical_bytes', 0)} "
                       f"volumes={len(vols)} failed_volumes={failed} "
-                      f"stalls={stalls}")
+                      f"stalls={stalls}{degraded}")
         elif args.op == "-savenamespace":
             c._call("save_namespace")
             print("namespace saved")

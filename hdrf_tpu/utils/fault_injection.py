@@ -17,7 +17,7 @@ _lock = threading.Lock()
 
 def point(name: str, **kw: Any) -> None:
     """Declare an injection point. Called from main code at precise moments,
-    e.g. ``fault_injection.point("block_receiver.before_finalize", block=blk)``."""
+    e.g. ``fault_injection.point("replica.finalize", block_id=bid)``."""
     h = _handlers.get(name)
     if h is not None:
         h(**kw)

@@ -1,0 +1,305 @@
+"""Resilience primitives: deadline budgets, capped backoff, circuit breakers.
+
+The reference stack has these scattered across Hadoop IPC — RetryPolicies
+(RetryPolicies.java:153 ``exponentialBackoffRetry``), the failover proxy's
+retry loop (RetryInvocationHandler.java:88), and per-protocol socket
+timeouts (DataNode.java:436 ``socketTimeout``) — and the fork's reduction
+path has NONE (SURVEY.md §5: a hung codec stalls writes forever).  This
+module is the one place hdrf_tpu's cross-daemon edges get their failure
+policy from:
+
+- :class:`Deadline` — an absolute time budget.  It propagates hop-by-hop
+  as a REMAINING-SECONDS header (``_deadline`` on RPC kwargs / DT op
+  fields, riding beside ``_trace``): wall clocks are not synchronized
+  across hosts, so each hop rebinds the remaining budget against its own
+  monotonic clock — the hrpc/gRPC deadline-propagation shape.  The active
+  deadline is ambient (contextvar, like tracing's current span): servers
+  bind the inbound header around the handler, clients stamp the remaining
+  budget on outbound calls, so a client's 30 s budget bounds the whole
+  client->NN->DN->worker chain.
+- :func:`backoff_delays` — capped exponential backoff with FULL jitter
+  (delay ~ U(0, min(cap, base*2^i)); the AWS-architecture-blog rule the
+  reference approximates at RetryPolicies.java:153).
+- :class:`CircuitBreaker` — consecutive-failure breaker:
+  closed -> open after N failures, half-open single probe after the reset
+  timeout, re-close on probe success (the Polly/Hystrix state machine the
+  reference lacks entirely).  Clocks are injectable so tests drive state
+  transitions without wall-clock sleeps (the utils/outlier.py convention).
+
+Per-edge breakers live in a process-wide registry; their state/transition
+counters are mirrored into the ``resilience`` metrics registry, so
+utils/prom.py exposition (and bench.py's JSON line) export them with zero
+extra wiring: ``hdrf_breaker_open_total``, ``hdrf_breaker_state{...}``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import random
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+from hdrf_tpu.utils import metrics
+
+_M = metrics.registry("resilience")
+
+#: reserved header key — rides RPC kwargs and DT op fields beside ``_trace``
+DEADLINE_KEY = "_deadline"
+
+
+class DeadlineExceeded(TimeoutError):
+    """The operation's time budget is exhausted (raised BEFORE issuing
+    further network work, so a spent budget costs zero connect attempts)."""
+
+
+class Deadline:
+    """Absolute time budget against an injectable monotonic clock."""
+
+    __slots__ = ("_expires", "_clock")
+
+    def __init__(self, budget_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._expires = clock() + float(budget_s)
+
+    def remaining(self) -> float:
+        """Seconds left (never negative)."""
+        return max(0.0, self._expires - self._clock())
+
+    @property
+    def expired(self) -> bool:
+        return self._clock() >= self._expires
+
+    def check(self, what: str = "operation") -> None:
+        if self.expired:
+            _M.incr("deadline_exceeded_total")
+            raise DeadlineExceeded(f"{what}: deadline budget exhausted")
+
+    def extend(self, extra_s: float) -> None:
+        """Grow the budget (payload-scaled deadlines accrue per streamed
+        MiB because stream sizes are only known as bytes arrive)."""
+        self._expires += float(extra_s)
+
+    def timeout(self, cap_s: float | None = None) -> float:
+        """A socket/step timeout honoring both the budget and ``cap_s``."""
+        rem = self.remaining()
+        return rem if cap_s is None else min(rem, cap_s)
+
+    def header(self) -> float:
+        """The hop-by-hop wire form: remaining seconds (receivers rebind
+        against their own clock, which is the decrement)."""
+        return self.remaining()
+
+
+_current_deadline: contextvars.ContextVar[Deadline | None] = \
+    contextvars.ContextVar("hdrf_deadline", default=None)
+
+
+def current() -> Deadline | None:
+    """The ambient deadline, if any (the tracing.current_context analog)."""
+    return _current_deadline.get()
+
+
+def remaining_header() -> float | None:
+    """Remaining-seconds header for outbound calls; None = no deadline."""
+    d = _current_deadline.get()
+    return None if d is None else d.header()
+
+
+@contextlib.contextmanager
+def bind(deadline: Deadline | None) -> Iterator[Deadline | None]:
+    """Make ``deadline`` ambient for the body (None = explicitly unbound)."""
+    tok = _current_deadline.set(deadline)
+    try:
+        yield deadline
+    finally:
+        _current_deadline.reset(tok)
+
+
+@contextlib.contextmanager
+def bind_remaining(remaining_s: float | None,
+                   clock: Callable[[], float] = time.monotonic,
+                   ) -> Iterator[Deadline | None]:
+    """Rebind an inbound ``_deadline`` header (remaining seconds) against
+    the local clock; no header = no ambient deadline for this handler."""
+    if remaining_s is None:
+        yield None
+        return
+    with bind(Deadline(float(remaining_s), clock=clock)) as d:
+        yield d
+
+
+def effective_budget(budget_s: float) -> float:
+    """Clamp a local per-op budget by the ambient deadline (a hop may
+    never outlive the end-to-end budget it inherited)."""
+    d = _current_deadline.get()
+    return budget_s if d is None else min(budget_s, d.remaining())
+
+
+def backoff_delays(attempts: int, base_s: float = 0.05, cap_s: float = 2.0,
+                   rng: random.Random | None = None) -> Iterator[float]:
+    """Capped exponential backoff with full jitter: attempt i sleeps
+    U(0, min(cap_s, base_s * 2**i)).  Yields ``attempts`` delays."""
+    rng = rng or random
+    for i in range(attempts):
+        yield rng.uniform(0.0, min(cap_s, base_s * (2.0 ** i)))
+
+
+def call_with_retries(fn: Callable[[], Any], attempts: int = 3,
+                      retry_on: tuple = (ConnectionError, OSError),
+                      base_s: float = 0.05, cap_s: float = 2.0,
+                      sleep: Callable[[float], None] = time.sleep,
+                      rng: random.Random | None = None,
+                      on_retry: Callable[[Exception], None] | None = None,
+                      ) -> Any:
+    """Run ``fn`` with capped-exponential-full-jitter retries, honoring the
+    ambient deadline: a spent budget raises :class:`DeadlineExceeded`
+    instead of sleeping into it."""
+    last: Exception | None = None
+    delays = backoff_delays(max(0, attempts - 1), base_s, cap_s, rng)
+    for attempt in range(attempts):
+        d = _current_deadline.get()
+        if d is not None:
+            d.check("retry loop")
+        try:
+            return fn()
+        except retry_on as e:  # type: ignore[misc]
+            last = e
+            _M.incr("retries_total")
+            if on_retry is not None:
+                on_retry(e)
+        if attempt < attempts - 1:
+            delay = next(delays)
+            if d is not None:
+                delay = min(delay, d.remaining())
+            if delay > 0:
+                sleep(delay)
+    raise last  # type: ignore[misc]
+
+
+class BreakerOpen(IOError):
+    """Fail-fast refusal: the edge's breaker is open (no connect attempt
+    was made — callers fall straight into their degraded path)."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with a single half-open probe.
+
+    closed --N consecutive failures--> open --reset_s elapsed--> half-open
+    (exactly one caller admitted as the probe) --success--> closed /
+    --failure--> open again.  ``clock`` is injectable so tests drive every
+    transition deterministically.
+    """
+
+    _STATE_NUM = {"closed": 0, "half_open": 1, "open": 2}
+
+    def __init__(self, name: str, failure_threshold: int = 3,
+                 reset_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_s = float(reset_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0          # consecutive
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._export()
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call proceed?  open = no; half-open admits ONE probe."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == "closed":
+                return True
+            if self._state == "half_open" and not self._probe_inflight:
+                self._probe_inflight = True
+                _M.incr("breaker_probes_total")
+                return True
+            _M.incr("breaker_rejections_total")
+            return False
+
+    def check(self) -> None:
+        """``allow`` that raises :class:`BreakerOpen` instead."""
+        if not self.allow():
+            raise BreakerOpen(f"circuit breaker '{self.name}' is open")
+
+    # ----------------------------------------------------------- outcomes
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            if self._state != "closed":
+                self._state = "closed"
+                _M.incr("breaker_close_total")
+            self._export()
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            was = self._state
+            if was == "half_open" or (was == "closed" and
+                                      self._failures
+                                      >= self.failure_threshold):
+                self._state = "open"
+                self._opened_at = self._clock()
+                self._probe_inflight = False
+                if was != "open":
+                    _M.incr("breaker_open_total")
+            self._export()
+
+    # ----------------------------------------------------------- internals
+
+    def _maybe_half_open(self) -> None:
+        """Caller holds the lock."""
+        if self._state == "open" \
+                and self._clock() - self._opened_at >= self.reset_s:
+            self._state = "half_open"
+            self._probe_inflight = False
+            self._export()
+
+    def _export(self) -> None:
+        """Caller holds the lock.  Gauges keep per-edge state visible in
+        /prom; the transition counters above are family-wide."""
+        _M.gauge(f"breaker_state.{self.name}",
+                 self._STATE_NUM[self._state])
+
+
+_breakers: dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker(name: str, failure_threshold: int = 3, reset_s: float = 5.0,
+            clock: Callable[[], float] = time.monotonic) -> CircuitBreaker:
+    """Process-wide per-edge breaker registry (one breaker per edge name,
+    e.g. ``dn-0->worker``); parameters apply only on first creation."""
+    with _breakers_lock:
+        b = _breakers.get(name)
+        if b is None:
+            b = _breakers[name] = CircuitBreaker(
+                name, failure_threshold=failure_threshold,
+                reset_s=reset_s, clock=clock)
+        return b
+
+
+def all_breakers() -> dict[str, CircuitBreaker]:
+    with _breakers_lock:
+        return dict(_breakers)
+
+
+def reset_breakers() -> None:
+    """Drop every registered breaker (test isolation)."""
+    with _breakers_lock:
+        _breakers.clear()

@@ -430,3 +430,7 @@ class TestBenchContract:
         # the bench runs no cluster)
         assert float(doc["dedup_ratio"]) >= 1.0
         assert int(doc["slow_peer_count"]) == 0
+        # degraded-mode health of the run: no breaker tripped, no write
+        # fell back mid-bench (either would taint the throughput verdict)
+        assert int(doc["resilience"]["breaker_open_total"]) == 0
+        assert int(doc["resilience"]["degraded_writes"]) == 0
