@@ -33,7 +33,12 @@ Prints ONE JSON line:
    "e2e_value": <MB/s>, "e2e_vs_baseline": <x>,
    "e2e_ratio_tpu": <r>, "e2e_ratio_cpu": <r>,
    "tg_value": <MB/s>, "tg_vs_baseline": <x>,
-   "tg_ratio_tpu": <r>, "tg_ratio_cpu": <r>}   # TeraGen-row corpus
+   "tg_ratio_tpu": <r>, "tg_ratio_cpu": <r>,   # TeraGen-row corpus
+   "phase_profile": {"wall_s", "classes", "phases",
+                     "overlap_efficiency", "attributed_frac"}}
+                                               # write-path critical-path
+                                               # profiler window over the
+                                               # e2e passes (utils/profiler)
 """
 
 from __future__ import annotations
@@ -173,15 +178,18 @@ def _cpu_full(blocks: list[np.ndarray], cdc, tmp: str, tag: str):
     hook — same code path the TPU pass uses)."""
     from hdrf_tpu import native
     from hdrf_tpu.ops.dispatch import gear_mask
+    from hdrf_tpu.utils import profiler
 
     mask = gear_mask(cdc)
     state = {"stored": 0}
 
     def seal_now(cid, payload):
-        comp = native.lz4_compress(payload)
+        with profiler.phase("reduce_compute"):
+            comp = native.lz4_compress(payload)
         out = comp if len(comp) < len(payload) else payload
-        with open(os.path.join(tmp, tag, f"sealed.{cid}"), "wb") as f:
-            f.write(out)
+        with profiler.phase("container_io"):
+            with open(os.path.join(tmp, tag, f"sealed.{cid}"), "wb") as f:
+                f.write(out)
         state["stored"] += len(out)
 
     index, containers = _fresh_stores(tmp, tag, on_roll=seal_now)
@@ -189,9 +197,14 @@ def _cpu_full(blocks: list[np.ndarray], cdc, tmp: str, tag: str):
     t0 = time.perf_counter()
     total = 0
     for bid, buf in enumerate(blocks):
-        cuts = native.cdc_chunk(buf, mask, cdc.min_chunk, cdc.max_chunk)
-        starts = np.concatenate([[0], cuts[:-1]]).astype(np.uint64)
-        digs = native.sha256_batch(buf, starts, (cuts - starts).astype(np.uint64))
+        # direct native calls bypass ops/dispatch.py, so the pass phases
+        # its own CDC+SHA stage (the rest — dedup_lookup, wal_commit,
+        # container_io — is phased inside the product code it calls)
+        with profiler.phase("reduce_compute"):
+            cuts = native.cdc_chunk(buf, mask, cdc.min_chunk, cdc.max_chunk)
+            starts = np.concatenate([[0], cuts[:-1]]).astype(np.uint64)
+            digs = native.sha256_batch(buf, starts,
+                                       (cuts - starts).astype(np.uint64))
         _dedup_bookkeeping(bid, buf, cuts, digs, index, containers,
                            on_seal=on_seal)
         total += buf.size
@@ -258,10 +271,29 @@ def _resilience_summary() -> dict:
     }
 
 
+def _phase_profile(t0: float, t1: float) -> dict:
+    """Cross-thread overlap profile of [t0, t1] for the JSON line: wall
+    partitioned into the profiler's exclusive classes (host/device busy,
+    transport wait, idle — sums exactly to wall_s), per-phase exclusive
+    seconds, the overlap-efficiency ratio (wait hidden under host work /
+    total hideable wait — the 1-vCPU host's only lever, PERF_NOTES round
+    4), and attributed_frac (share of wall inside any named phase)."""
+    from hdrf_tpu.utils import profiler
+
+    prof = profiler.window_profile(t0, t1)
+    return {
+        "wall_s": round(prof["wall_s"], 3),
+        "classes": {k: round(v, 3) for k, v in prof["classes"].items()},
+        "phases": {k: round(v, 3) for k, v in sorted(prof["phases"].items())},
+        "overlap_efficiency": round(prof["overlap_efficiency"], 3),
+        "attributed_frac": round(prof["attributed_frac"], 3),
+    }
+
+
 def main() -> None:
     from hdrf_tpu.config import CdcConfig
     from hdrf_tpu.ops.dispatch import resolve_backend
-    from hdrf_tpu.utils import device_ledger
+    from hdrf_tpu.utils import device_ledger, profiler
 
     led0 = device_ledger.stamp()   # dispatch-ledger baseline for the run
     cdc = CdcConfig()
@@ -284,11 +316,13 @@ def main() -> None:
         backend = resolve_backend("auto")
         if backend != "tpu":
             cpu_e2e, cpu_ratio, cpu_dr = 0.0, 1.0, 1.0
+            p0 = profiler.mark()   # phase-profile window: the e2e passes
             for i in range(2):
                 os.sync()  # settle writeback between ~0.5 GB passes
                 v, rr, dr = _cpu_full(e2e_hosts, cdc, tmp, f"cpu{i}")
                 if v > cpu_e2e:
                     cpu_e2e, cpu_ratio, cpu_dr = v, rr, dr
+            phase_profile = _phase_profile(p0, profiler.mark())
             led = device_ledger.delta(led0)
             print(json.dumps({
                 "metric": "block reduction pipeline throughput (CDC+SHA-256), "
@@ -303,6 +337,7 @@ def main() -> None:
                 "cdc_fused": _cdc_fused_summary(),
                 "stalls": led.get("stall_total", 0),
                 "resilience": _resilience_summary(),
+                "phase_profile": phase_profile,
             }))
             return
 
@@ -586,7 +621,9 @@ def main() -> None:
         # throttling stalls whichever pass draws it by ~35 s, observed on
         # the first post-warm TPU pass twice) must stay below the median's
         # breakdown point.
+        p0 = profiler.mark()   # phase-profile window: the paired e2e rounds
         e2e = paired(e2e_hosts, "tpu", rounds=5)
+        phase_profile = _phase_profile(p0, profiler.mark())
 
         # TeraGen-row corpus: the north-star benchmark's own data
         # (BASELINE.json "TeraGen 100 GB, equal ratio").
@@ -624,6 +661,7 @@ def main() -> None:
             "cdc_fused": _cdc_fused_summary(),
             "stalls": led.get("stall_total", 0),
             "resilience": _resilience_summary(),
+            "phase_profile": phase_profile,
         }))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -36,7 +36,7 @@ from dataclasses import dataclass
 
 import msgpack
 
-from hdrf_tpu.utils import fault_injection, wal as walmod
+from hdrf_tpu.utils import fault_injection, profiler, wal as walmod
 
 WAL_NAME = "index.wal"
 CKPT_NAME = "index.ckpt"
@@ -150,16 +150,17 @@ class ChunkIndex:
                 self._seq += 1
             # note: pending records were applied at buffer time; only the
             # WAL bytes were deferred
-        buf = bytearray()
-        for i, rec in enumerate(recs):
-            buf += walmod.frame(msgpack.packb([self._seq + 1 + i, *rec]))
-        fault_injection.point("index.wal_append")
-        self._wal.write(bytes(buf))
-        self._wal.flush()
-        os.fsync(self._wal.fileno())
-        for rec in recs:
-            self._seq += 1
-            self._apply(rec)
+        with profiler.phase("wal_commit"):
+            buf = bytearray()
+            for i, rec in enumerate(recs):
+                buf += walmod.frame(msgpack.packb([self._seq + 1 + i, *rec]))
+            fault_injection.point("index.wal_append")
+            self._wal.write(bytes(buf))
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+            for rec in recs:
+                self._seq += 1
+                self._apply(rec)
         self._ops_since_ckpt += len(recs)
         if self._ops_since_ckpt >= self._checkpoint_every:
             self._checkpoint_locked()
@@ -182,7 +183,7 @@ class ChunkIndex:
         semantics as commit_block; returns the union of race-loser
         fingerprints."""
         losers: list[bytes] = []
-        with self._lock:
+        with profiler.phase("wal_commit"), self._lock:
             recs = []
             seen_new: set[bytes] = set()
             for block_id, logical_len, hashes, new_chunks in blocks:
@@ -216,7 +217,7 @@ class ChunkIndex:
         first commit wins; later commits keep the existing location and the
         loser's container bytes become orphans (reclaimed by compaction).
         Returns the fingerprints that lost such races."""
-        with self._lock:
+        with profiler.phase("wal_commit"), self._lock:
             losers = [h for h in new_chunks if h in self._chunks]
             fresh = {h: loc for h, loc in new_chunks.items() if h not in self._chunks}
             for h in hashes:

@@ -21,6 +21,7 @@ import numpy as np
 
 from hdrf_tpu.config import CdcConfig
 from hdrf_tpu.utils import metrics as _metrics
+from hdrf_tpu.utils import profiler as _profiler
 
 # Op-level accounting at the dispatch boundary (per-dispatch device
 # accounting lives in utils/device_ledger.py, fed by the ops modules).
@@ -134,8 +135,13 @@ def chunk_and_fingerprint(data: bytes | np.ndarray, cdc: CdcConfig,
         if r is None:
             r = _resident_cache[key] = ResidentReducer(cdc, fused_mode=key[3])
         return r.reduce(data)
-    cuts = chunk_cuts(data, cdc, backend)
-    return cuts, fingerprints(data, cuts, backend)
+    # Native CDC+SHA run synchronously on the host, so they are a host
+    # phase; the jax paths above must NOT be wrapped here — their wall time
+    # is dominated by blocking device waits the ledger already attributes
+    # as device_wait, and a host phase would misclassify that overlap.
+    with _profiler.phase("reduce_compute"):
+        cuts = chunk_cuts(data, cdc, backend)
+        return cuts, fingerprints(data, cuts, backend)
 
 
 _tpu_lz4 = None

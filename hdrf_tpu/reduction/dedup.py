@@ -36,7 +36,7 @@ import numpy as np
 from hdrf_tpu.ops import dispatch
 from hdrf_tpu.reduction import accounting, scheme as scheme_mod
 from hdrf_tpu.reduction.scheme import ReductionContext, ReductionScheme
-from hdrf_tpu.utils import metrics, tracing
+from hdrf_tpu.utils import metrics, profiler, tracing
 
 _M = metrics.registry("dedup")
 
@@ -89,18 +89,20 @@ def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
     storeDB :372-392).  Shared by DedupScheme.reduce and the full-path
     benchmark so the timed path IS the product path.  Returns
     (chunk_count, new_unique_count, new_unique_bytes)."""
-    mv, hashes, first_range = _block_prep(data, cuts, digests)
-    n = len(cuts)
-    if index.get_block(block_id) is not None:
-        # Supersede (append rewrote the block under a new gen stamp):
-        # release the old entry's chunk refs before committing the new one —
-        # CDC makes the rewrite dedup against its own old chunks, so the
-        # released refs are mostly re-taken by the commit below.
-        index.delete_block(block_id)
-    known = index.lookup_chunks(list(first_range))
+    with profiler.phase("dedup_lookup"):
+        mv, hashes, first_range = _block_prep(data, cuts, digests)
+        n = len(cuts)
+        if index.get_block(block_id) is not None:
+            # Supersede (append rewrote the block under a new gen stamp):
+            # release the old entry's chunk refs before committing the new
+            # one — CDC makes the rewrite dedup against its own old chunks,
+            # so the released refs are mostly re-taken by the commit below.
+            index.delete_block(block_id)
+        known = index.lookup_chunks(list(first_range))
     new_hashes = [h for h, loc in known.items() if loc is None]
-    locs = _append_new(containers, data, first_range, new_hashes,
-                       on_seal or index.seal_container)
+    with profiler.phase("container_io"):
+        locs = _append_new(containers, data, first_range, new_hashes,
+                           on_seal or index.seal_container)
     index.commit_block(block_id, len(data), hashes,
                        dict(zip(new_hashes, locs)))
     _M.incr("chunks_total", n)
@@ -143,6 +145,7 @@ class CommitPipeline:
                digests: np.ndarray) -> Future:
         fut: Future = Future()
         self._q.put((block_id, data, cuts, digests, fut))
+        profiler.counter_set("wal_queue_depth", self._q.qsize())
         return fut
 
     def close(self) -> None:
@@ -167,28 +170,32 @@ class CommitPipeline:
             self._commit_batch(items)
 
     def _commit_batch(self, items: list) -> None:
+        profiler.counter_set("wal_queue_depth", self._q.qsize())
         try:
             recs = []
             # chunks first seen earlier IN this batch: visible to later
             # blocks' dedup even though the index hasn't applied them yet
             pending_new: dict[bytes, tuple[int, int, int]] = {}
             for block_id, data, cuts, digests, _ in items:
-                mv, hashes, first_range = _block_prep(data, cuts, digests)
-                if self._index.get_block(block_id) is not None:
-                    self._index.delete_block(block_id)
-                probe = [h for h in first_range if h not in pending_new]
-                known = self._index.lookup_chunks(probe)
+                with profiler.phase("dedup_lookup"):
+                    mv, hashes, first_range = _block_prep(data, cuts, digests)
+                    if self._index.get_block(block_id) is not None:
+                        self._index.delete_block(block_id)
+                    probe = [h for h in first_range if h not in pending_new]
+                    known = self._index.lookup_chunks(probe)
                 new_hashes = [h for h in probe if known[h] is None]
-                locs = _append_new(self._containers, data, first_range,
-                                   new_hashes, self._on_seal, sync=False)
+                with profiler.phase("container_io"):
+                    locs = _append_new(self._containers, data, first_range,
+                                       new_hashes, self._on_seal, sync=False)
                 new = dict(zip(new_hashes, locs))
                 pending_new.update(new)
                 recs.append((block_id, len(data), hashes, new))
                 _M.incr("chunks_total", len(hashes))
                 _M.incr("chunks_new", len(new_hashes))
                 accounting.record_dedup_block(len(hashes), len(new_hashes))
-            self._containers.sync_lanes()  # bytes at least as durable as
-            # the store's policy allows BEFORE the index references them
+            with profiler.phase("container_io"):
+                self._containers.sync_lanes()  # bytes at least as durable as
+                # the store's policy allows BEFORE the index references them
             self._index.commit_blocks(recs)
             for *_, fut in items:
                 fut.set_result(None)

@@ -23,6 +23,13 @@ Checksums: crc32c per ``checksum_chunk`` of the LOGICAL bytes are computed on
 ingest and stored in BlockMeta (the reference writes the checksum meta file
 even in reduction mode, BlockReceiver.java:924-986) so readers can verify
 end-to-end regardless of the stored form.
+
+Every ingest path opens a utils/profiler.py BlockTimeline and attributes its
+wall time to named phases (``recv``/``checksum``/``container_io``/
+``mirror_stream``/``ack`` here; ``dedup_lookup``/``wal_commit`` land from
+reduction/dedup.py and index/chunk_index.py; ``device_wait`` from the device
+ledger) — the decomposition the gap-attribution report and ROADMAP item 1's
+pipeline refactor are measured by.
 """
 
 from __future__ import annotations
@@ -34,7 +41,8 @@ from typing import TYPE_CHECKING
 from hdrf_tpu import native
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import recv_frame, send_frame
-from hdrf_tpu.utils import fault_injection, log, metrics, retry, tracing
+from hdrf_tpu.utils import (fault_injection, log, metrics, profiler, retry,
+                            tracing)
 
 if TYPE_CHECKING:
     from hdrf_tpu.server.datanode import DataNode
@@ -90,10 +98,12 @@ class BlockReceiver:
         block_id, gen_stamp = fields["block_id"], fields["gen_stamp"]
         targets = fields.get("targets", [])
         mirror_sock = None
-        with dn.direct_slot():  # bounded concurrent streaming writes
-            writer = dn.replicas.create_rbw(
-                block_id, gen_stamp,
-                storage_type=fields.get("storage_type"))
+        with profiler.block_timeline(block_id) as tl, \
+                dn.direct_slot():  # bounded concurrent streaming writes
+            with profiler.phase("container_io"):
+                writer = dn.replicas.create_rbw(
+                    block_id, gen_stamp,
+                    storage_type=fields.get("storage_type"))
             try:
                 if targets:
                     mirror_sock = _connect(targets[0]["addr"], dn, block_id,
@@ -111,24 +121,28 @@ class BlockReceiver:
                 drained = 0   # mirror acks consumed by flush barriers
                 fwd_bytes = 0
                 mirror_t = 0.0  # downstream-only time (write + ack drain)
-                for seqno, data, flags in dt.iter_packets_ex(sock):
+                for seqno, data, flags in profiler.timed_iter(
+                        "recv", dt.iter_packets_ex(sock)):
                     last = bool(flags & dt.FLAG_LAST)
                     fault_injection.point("block_receiver.packet",
                                           block_id=block_id, seqno=seqno,
                                           dn_id=dn.dn_id)
                     if mirror_sock is not None:
                         _mt0 = time.perf_counter()
-                        dt.write_packet(mirror_sock, seqno, data,
-                                        flags=flags)
+                        with profiler.phase("mirror_stream"):
+                            dt.write_packet(mirror_sock, seqno, data,
+                                            flags=flags)
                         mirror_t += time.perf_counter() - _mt0
                         forwarded += 1
                         fwd_bytes += len(data)
                     if data:
-                        writer.write(data)
-                        tail += data
-                        while len(tail) >= cchunk:
-                            crcs.append(native.crc32c(tail[:cchunk]))
-                            tail = tail[cchunk:]
+                        with profiler.phase("container_io"):
+                            writer.write(data)
+                        with profiler.phase("checksum"):
+                            tail += data
+                            while len(tail) >= cchunk:
+                                crcs.append(native.crc32c(tail[:cchunk]))
+                                tail = tail[cchunk:]
                     if not last and flags & (dt.FLAG_FLUSH | dt.FLAG_SYNC):
                         # hflush/hsync barrier: every downstream node must
                         # have processed the prefix before we ack (the
@@ -138,18 +152,23 @@ class BlockReceiver:
                         status = dt.ACK_SUCCESS
                         if mirror_sock is not None:
                             _mt0 = time.perf_counter()
-                            while drained < forwarded:
-                                _, down = dt.read_ack(mirror_sock)
-                                status = max(status, down)
-                                drained += 1
+                            with profiler.phase("mirror_stream"):
+                                while drained < forwarded:
+                                    _, down = dt.read_ack(mirror_sock)
+                                    status = max(status, down)
+                                    drained += 1
                             mirror_t += time.perf_counter() - _mt0
                         vis_crcs = crcs + ([native.crc32c(tail)]
                                            if tail else [])
-                        writer.flush_visible(vis_crcs, cchunk,
-                                             sync=bool(flags & dt.FLAG_SYNC))
-                        dt.send_ack(sock, seqno, status)
+                        with profiler.phase("container_io"):
+                            writer.flush_visible(
+                                vis_crcs, cchunk,
+                                sync=bool(flags & dt.FLAG_SYNC))
+                        with profiler.phase("ack"):
+                            dt.send_ack(sock, seqno, status)
                     elif not last:
-                        dt.send_ack(sock, seqno)
+                        with profiler.phase("ack"):
+                            dt.send_ack(sock, seqno)
                     else:
                         if tail:
                             crcs.append(native.crc32c(tail))
@@ -159,17 +178,22 @@ class BlockReceiver:
                             # the final one carries the aggregated downstream
                             # status — earlier ones are flow control.
                             _mt0 = time.perf_counter()
-                            for _ in range(forwarded - drained):
-                                _, down = dt.read_ack(mirror_sock)
-                                status = max(status, down)
+                            with profiler.phase("mirror_stream"):
+                                for _ in range(forwarded - drained):
+                                    _, down = dt.read_ack(mirror_sock)
+                                    status = max(status, down)
                             mirror_t += time.perf_counter() - _mt0
                             self._note_peer(targets[0], mirror_t, fwd_bytes)
-                        meta = writer.finalize(writer.bytes_written, "direct",
-                                               crcs, cchunk)
+                        with profiler.phase("container_io"):
+                            meta = writer.finalize(writer.bytes_written,
+                                                   "direct", crcs, cchunk)
                         writer = None
-                        dn.notify_block_received(block_id, meta.logical_len,
-                                                 meta.gen_stamp)
-                        dt.send_ack(sock, seqno, status)
+                        tl.nbytes = meta.logical_len
+                        with profiler.phase("ack"):
+                            dn.notify_block_received(block_id,
+                                                     meta.logical_len,
+                                                     meta.gen_stamp)
+                            dt.send_ack(sock, seqno, status)
                         _M.incr("blocks_received_direct")
             except (ConnectionError, OSError, IOError):
                 # Pipeline died mid-stream (client/upstream crash): persist
@@ -230,10 +254,12 @@ class BlockReceiver:
         scheme_name = fields["scheme"]
         targets = fields.get("targets", [])
         scheme = dn.scheme(scheme_name)
-        with dn.write_slot():  # admission BEFORE buffering
+        with profiler.block_timeline(block_id) as tl, \
+                dn.write_slot():  # admission BEFORE buffering
             parts: list[bytes] = []
             last_seqno = [0]
-            packets = dt.iter_packets(sock)
+            # each next() wait on the client stream is one "recv" span
+            packets = profiler.timed_iter("recv", dt.iter_packets(sock))
 
             def stream():
                 for seqno, data, last in packets:
@@ -249,7 +275,8 @@ class BlockReceiver:
                     # consumer abandoning the generator mid-yield (worker
                     # death) must lose neither the ack nor the bytes
                     if not last:
-                        dt.send_ack(sock, seqno)
+                        with profiler.phase("ack"):
+                            dt.send_ack(sock, seqno)
                     if data:
                         parts.append(data)
                         yield data
@@ -284,7 +311,9 @@ class BlockReceiver:
             else:
                 for _ in stream():
                     pass
-            data = b"".join(parts)
+            with profiler.phase("buffer_assemble"):
+                data = b"".join(parts)
+            tl.nbytes = len(data)
             if worker_down:
                 # compute here WITHOUT re-trying the dead worker (the
                 # scheme would otherwise reconnect per block while the
@@ -309,7 +338,8 @@ class BlockReceiver:
                 status = self._store_and_mirror(
                     block_id, gen_stamp, scheme_name, data, targets,
                     precomputed=precomputed)
-        dt.send_ack(sock, last_seqno[0], status)
+            with profiler.phase("ack"):
+                dt.send_ack(sock, last_seqno[0], status)
         _M.incr("blocks_received_reduced")
 
     def _store_and_mirror(self, block_id: int, gen_stamp: int, scheme_name: str,
@@ -317,18 +347,26 @@ class BlockReceiver:
                           precomputed=None) -> int:
         dn = self._dn
         scheme = dn.scheme(scheme_name)
-        crcs = _checksums(data, dn.checksum_chunk)
+        with profiler.phase("checksum"):
+            crcs = _checksums(data, dn.checksum_chunk)
         with metrics.registry("datanode").time("reduce_us"):
+            # no host phase around reduce itself: the native path records
+            # "reduce_compute" at the dispatch choke point, the worker path
+            # records "device_wait" at its final drain, and the in-process
+            # jax path is attributed by the device ledger
             if precomputed is not None:
                 stored = scheme.reduce_with(block_id, data, *precomputed,
                                             dn.reduction_ctx)
             else:
                 stored = scheme.reduce(block_id, data, dn.reduction_ctx)
-        writer = dn.replicas.create_rbw(block_id, gen_stamp)
+        with profiler.phase("container_io"):
+            writer = dn.replicas.create_rbw(block_id, gen_stamp)
         try:
-            if stored:
-                writer.write(stored)
-            meta = writer.finalize(len(data), scheme_name, crcs, dn.checksum_chunk)
+            with profiler.phase("container_io"):
+                if stored:
+                    writer.write(stored)
+                meta = writer.finalize(len(data), scheme_name, crcs,
+                                       dn.checksum_chunk)
         except (OSError, ValueError) as e:
             # storage-layer failure (disk IO / corrupt state): clean up the
             # rbw, log with the active trace, and re-raise — the xceiver
@@ -343,7 +381,9 @@ class BlockReceiver:
             else:
                 writer.abort()
             raise
-        dn.notify_block_received(block_id, meta.logical_len, meta.gen_stamp)
+        with profiler.phase("ack"):
+            dn.notify_block_received(block_id, meta.logical_len,
+                                     meta.gen_stamp)
         status = dt.ACK_SUCCESS
         if targets:
             try:
@@ -386,48 +426,55 @@ class BlockReceiver:
         push_t0 = time.perf_counter()
         mirror = _connect(targets[0]["addr"], dn, block_id)
         try:
-            if getattr(scheme, "container_codec", None) is not None:
-                # dedup family: hashes + need-list negotiation + chunk delta
-                entry = dn.index.get_block(block_id)
-                if entry is None:
-                    raise IOError(f"block {block_id} missing from chunk index")
-                dt.send_op(mirror, "write_reduced", block_id=block_id,
-                           gen_stamp=gen_stamp, scheme=scheme_name,
-                           logical_len=logical_len, checksums=crcs,
-                           checksum_chunk=dn.checksum_chunk,
-                           token=dn.tokens.mint(block_id, "w"),
-                           hashes=entry.hashes, targets=targets[1:])
-                need = recv_frame(mirror)["need"]  # indices into unique hash list
-                uniq = list(dict.fromkeys(entry.hashes))
-                needed_hashes = [uniq[i] for i in need]
-                locs = dn.index.lookup_chunks(needed_hashes)
-                chunk_locs = [(locs[h].container_id, locs[h].offset, locs[h].length)
-                              for h in needed_hashes]
-                chunks = dn.containers.read_chunks(chunk_locs)
-                seqno = 0
-                sent_bytes = 0
-                for chunk in chunks:
-                    if throttler is not None:
-                        throttler.throttle(len(chunk))
-                    dt.write_packet(mirror, seqno, chunk)
-                    sent_bytes += len(chunk)
-                    seqno += 1
-                dt.write_packet(mirror, seqno, b"", last=True)
-                _, status = dt.read_ack(mirror)
-            else:
-                # direct/compress family: ship the stored bytes as-is
-                dt.send_op(mirror, "write_reduced", block_id=block_id,
-                           gen_stamp=gen_stamp, scheme=scheme_name,
-                           logical_len=logical_len, checksums=crcs,
-                           checksum_chunk=dn.checksum_chunk,
-                           token=dn.tokens.mint(block_id, "w"),
-                           hashes=None, targets=targets[1:])
-                recv_frame(mirror)  # symmetric need-frame (always empty here)
-                dt.stream_bytes(mirror, stored, dn.config.packet_size,
-                                throttle=throttler.throttle
-                                if throttler is not None else None)
-                sent_bytes = len(stored)
-                _, status = dt.read_ack(mirror)
+            with profiler.phase("mirror_stream"):
+                if getattr(scheme, "container_codec", None) is not None:
+                    # dedup family: hashes + need-list negotiation + chunk
+                    # delta
+                    entry = dn.index.get_block(block_id)
+                    if entry is None:
+                        raise IOError(
+                            f"block {block_id} missing from chunk index")
+                    dt.send_op(mirror, "write_reduced", block_id=block_id,
+                               gen_stamp=gen_stamp, scheme=scheme_name,
+                               logical_len=logical_len, checksums=crcs,
+                               checksum_chunk=dn.checksum_chunk,
+                               token=dn.tokens.mint(block_id, "w"),
+                               hashes=entry.hashes, targets=targets[1:])
+                    # indices into unique hash list
+                    need = recv_frame(mirror)["need"]
+                    uniq = list(dict.fromkeys(entry.hashes))
+                    needed_hashes = [uniq[i] for i in need]
+                    with profiler.phase("dedup_lookup"):
+                        locs = dn.index.lookup_chunks(needed_hashes)
+                    chunk_locs = [(locs[h].container_id, locs[h].offset,
+                                   locs[h].length) for h in needed_hashes]
+                    with profiler.phase("container_io"):
+                        chunks = dn.containers.read_chunks(chunk_locs)
+                    seqno = 0
+                    sent_bytes = 0
+                    for chunk in chunks:
+                        if throttler is not None:
+                            throttler.throttle(len(chunk))
+                        dt.write_packet(mirror, seqno, chunk)
+                        sent_bytes += len(chunk)
+                        seqno += 1
+                    dt.write_packet(mirror, seqno, b"", last=True)
+                    _, status = dt.read_ack(mirror)
+                else:
+                    # direct/compress family: ship the stored bytes as-is
+                    dt.send_op(mirror, "write_reduced", block_id=block_id,
+                               gen_stamp=gen_stamp, scheme=scheme_name,
+                               logical_len=logical_len, checksums=crcs,
+                               checksum_chunk=dn.checksum_chunk,
+                               token=dn.tokens.mint(block_id, "w"),
+                               hashes=None, targets=targets[1:])
+                    # symmetric need-frame (always empty here)
+                    recv_frame(mirror)
+                    dt.stream_bytes(mirror, stored, dn.config.packet_size,
+                                    throttle=throttler.throttle
+                                    if throttler is not None else None)
+                    sent_bytes = len(stored)
+                    _, status = dt.read_ack(mirror)
             if status != dt.ACK_SUCCESS:
                 raise IOError(f"mirror returned status {status}")
             self._note_peer(targets[0], time.perf_counter() - push_t0,
@@ -446,28 +493,44 @@ class BlockReceiver:
         scheme_name, logical_len = fields["scheme"], fields["logical_len"]
         crcs, cchunk = fields["checksums"], fields["checksum_chunk"]
         hashes, targets = fields["hashes"], fields.get("targets", [])
+        with profiler.block_timeline(block_id, nbytes=logical_len):
+            self._ingest_reduced_inner(sock, dn, block_id, gen_stamp,
+                                       scheme_name, logical_len, crcs, cchunk,
+                                       hashes, targets)
+        _M.incr("blocks_ingested_reduced")
+
+    def _ingest_reduced_inner(self, sock, dn, block_id, gen_stamp, scheme_name,
+                              logical_len, crcs, cchunk, hashes,
+                              targets) -> None:
         stored = b""
         if hashes is not None:
             hashes = [bytes(h) for h in hashes]
             uniq = list(dict.fromkeys(hashes))
-            known = dn.index.lookup_chunks(uniq)
+            with profiler.phase("dedup_lookup"):
+                known = dn.index.lookup_chunks(uniq)
             need = [i for i, h in enumerate(uniq) if known[h] is None]
             send_frame(sock, {"need": need})
-            chunks = [data for _, data, last in dt.iter_packets(sock) if data]
+            chunks = [data for _, data, last in profiler.timed_iter(
+                "recv", dt.iter_packets(sock)) if data]
             if len(chunks) != len(need):
                 raise IOError(f"expected {len(need)} chunks, got {len(chunks)}")
-            locs = dn.containers.append_chunks(chunks,
-                                               on_seal=dn.index.seal_container)
+            with profiler.phase("container_io"):
+                locs = dn.containers.append_chunks(
+                    chunks, on_seal=dn.index.seal_container)
             new_chunks = {uniq[i]: loc for i, loc in zip(need, locs)}
             dn.index.commit_block(block_id, logical_len, hashes, new_chunks)
         else:
             send_frame(sock, {"need": []})
-            stored = dt.collect_packets(sock)
-        writer = dn.replicas.create_rbw(block_id, gen_stamp)
+            with profiler.phase("recv"):
+                stored = dt.collect_packets(sock)
+        with profiler.phase("container_io"):
+            writer = dn.replicas.create_rbw(block_id, gen_stamp)
         try:
-            if stored:
-                writer.write(stored)
-            meta = writer.finalize(logical_len, scheme_name, list(crcs), cchunk)
+            with profiler.phase("container_io"):
+                if stored:
+                    writer.write(stored)
+                meta = writer.finalize(logical_len, scheme_name, list(crcs),
+                                       cchunk)
         except (OSError, ValueError) as e:
             # same contract as _store_and_mirror: typed cleanup + traced
             # log + re-raise (no silent broad catch)
@@ -480,13 +543,15 @@ class BlockReceiver:
             else:
                 writer.abort()
             raise
-        dn.notify_block_received(block_id, meta.logical_len, meta.gen_stamp)
+        with profiler.phase("ack"):
+            dn.notify_block_received(block_id, meta.logical_len,
+                                     meta.gen_stamp)
         status = dt.ACK_SUCCESS
         if targets:  # relay down the chain
             try:
-                self.push_reduced(block_id, gen_stamp, scheme_name, logical_len,
-                                  stored, list(crcs), targets)
+                self.push_reduced(block_id, gen_stamp, scheme_name,
+                                  logical_len, stored, list(crcs), targets)
             except (OSError, ConnectionError, retry.DeadlineExceeded) as e:
                 self._note_mirror_failure(targets[0], block_id, e)
-        dt.send_ack(sock, 0, status)
-        _M.incr("blocks_ingested_reduced")
+        with profiler.phase("ack"):
+            dt.send_ack(sock, 0, status)

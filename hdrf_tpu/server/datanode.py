@@ -43,7 +43,7 @@ from hdrf_tpu.server.block_sender import BlockSender
 from hdrf_tpu.server.status_http import StatusHttpServer
 from hdrf_tpu.reduction import accounting
 from hdrf_tpu.utils import (device_ledger, fault_injection, log, metrics,
-                            retry, rollwin, tracing)
+                            profiler, retry, rollwin, tracing)
 from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("datanode")
@@ -558,7 +558,8 @@ class DataNode:
     def _serve_trace_spans(self, sock: socket.socket) -> None:
         out = {"daemon": self.dn_id,
                "spans": tracing.all_span_snapshots(),
-               "ledger": device_ledger.events_snapshot()}
+               "ledger": device_ledger.events_snapshot(),
+               "counters": profiler.counters_snapshot()}
         if self._worker is not None:
             from hdrf_tpu.server.reduction_worker import WorkerError
 
@@ -566,6 +567,8 @@ class DataNode:
                 w = self._worker.traces()
                 out["spans"] = out["spans"] + list(w.get("spans") or ())
                 out["ledger"] = out["ledger"] + list(w.get("ledger") or ())
+                out["counters"] = (out["counters"]
+                                   + list(w.get("counters") or ()))
             except (WorkerError, ConnectionError, OSError,
                     retry.DeadlineExceeded) as e:
                 # worker down: local view still serves

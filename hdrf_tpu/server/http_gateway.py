@@ -26,10 +26,11 @@ protocols, with the reference's protocol shapes:
   GET  /prom     Prometheus text exposition (gateway + NameNode registries,
                  the PrometheusMetricsSink analog);
   GET  /traces   cross-daemon trace assembly: local + NameNode + every live
-                 DataNode's spans and device-ledger events merged by
-                 trace_id (``?trace_id=`` filters; ``?format=chrome``
-                 renders Chrome/Perfetto trace_event JSON) — the pull-model
-                 replacement for the reference's HTrace span receivers;
+                 DataNode's spans, device-ledger events and profiler
+                 counter tracks merged by trace_id (``?trace_id=`` filters;
+                 ``?format=chrome`` renders Chrome/Perfetto trace_event
+                 JSON with counter tracks) — the pull-model replacement for
+                 the reference's HTrace span receivers;
   GET  /stacks   live thread stacks (HttpServer2 StackServlet analog);
   /dfshealth /datanode /journal /explorer  web UIs.
 """
@@ -147,7 +148,8 @@ class HttpGateway:
                         if q.get("format") == "chrome":
                             out = tracing.chrome_trace(
                                 out["spans"], out["ledger"],
-                                trace_id=q.get("trace_id"))
+                                trace_id=q.get("trace_id"),
+                                counters=out.get("counters", []))
                         return self._json(200, out)
                     if u.path == "/stacks":
                         return self._json(200, gateway.stacks())
@@ -489,18 +491,21 @@ class HttpGateway:
         return prom.render(snaps)
 
     def traces(self, trace_id: str | None = None) -> dict:
-        """Cross-daemon trace assembly: this process's spans + ledger,
-        the NameNode's (trace_spans RPC), and every live DataNode's
-        (trace_spans xceiver op; each DN proxies its co-located worker).
-        Spans dedupe by span_id, ledger events by (proc, id) — a daemon
-        polled twice (e.g. NN also reachable as a peer) merges clean."""
+        """Cross-daemon trace assembly: this process's spans + ledger +
+        profiler counter samples, the NameNode's (trace_spans RPC), and
+        every live DataNode's (trace_spans xceiver op; each DN proxies its
+        co-located worker).  Spans dedupe by span_id, ledger events and
+        counter samples by (proc, id) — a daemon polled twice (e.g. NN
+        also reachable as a peer) merges clean."""
         import socket as _socket
 
         from hdrf_tpu.proto import datatransfer as dt
         from hdrf_tpu.proto.rpc import recv_frame
+        from hdrf_tpu.utils import profiler
 
         spans = list(tracing.all_span_snapshots())
         ledger = list(device_ledger.events_snapshot())
+        counters = list(profiler.counters_snapshot())
         report = []
         try:
             with HdrfClient(self._nn_addr, name="http-gw") as c:
@@ -508,6 +513,7 @@ class HttpGateway:
                 nn = c._call("trace_spans")
                 spans.extend(nn.get("spans") or ())
                 ledger.extend(nn.get("ledger") or ())
+                counters.extend(nn.get("counters") or ())
         except (OSError, ConnectionError):
             _M.incr("traces_nn_unreachable")
         for d in report:
@@ -520,21 +526,27 @@ class HttpGateway:
                     out = recv_frame(s)
                 spans.extend(out.get("spans") or ())
                 ledger.extend(out.get("ledger") or ())
+                counters.extend(out.get("counters") or ())
             except (OSError, ConnectionError):
                 _M.incr("traces_dn_unreachable")
         seen_sp: set = set()
         seen_ev: set = set()
+        seen_ct: set = set()
         uspans = [s for s in spans
                   if s.get("span_id") not in seen_sp
                   and not seen_sp.add(s.get("span_id"))]
         uledger = [e for e in ledger
                    if (e.get("proc"), e.get("id")) not in seen_ev
                    and not seen_ev.add((e.get("proc"), e.get("id")))]
+        ucounters = [c for c in counters
+                     if (c.get("proc"), c.get("id")) not in seen_ct
+                     and not seen_ct.add((c.get("proc"), c.get("id")))]
         if trace_id is not None:
             uspans = [s for s in uspans if s.get("trace_id") == trace_id]
             uledger = [e for e in uledger
                        if e.get("trace_id") == trace_id]
-        return {"spans": uspans, "ledger": uledger}
+            ucounters = []  # counter samples have no trace affinity
+        return {"spans": uspans, "ledger": uledger, "counters": ucounters}
 
     def stacks(self) -> dict:
         """Gateway-process thread stacks (per-daemon stacks live on each

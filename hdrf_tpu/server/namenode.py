@@ -2865,11 +2865,12 @@ class NameNode:
         """This process's finished spans + device-ledger events, for the
         gateway's cross-daemon /traces merge (the span-receiver pull model
         replacing the reference's HTrace push receivers)."""
-        from hdrf_tpu.utils import device_ledger
+        from hdrf_tpu.utils import device_ledger, profiler
 
         return {"daemon": "namenode",
                 "spans": tracing.all_span_snapshots(),
-                "ledger": device_ledger.events_snapshot()}
+                "ledger": device_ledger.events_snapshot(),
+                "counters": profiler.counters_snapshot()}
 
     # Absolute slowness floor for the no-baseline rule: a peer whose median
     # downstream transfer is worse than 1 MB/s is pathological regardless of

@@ -38,7 +38,7 @@ from hdrf_tpu.config import CdcConfig
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import recv_frame, send_frame
 from hdrf_tpu.reduction import accounting
-from hdrf_tpu.utils import metrics, retry, tracing
+from hdrf_tpu.utils import metrics, profiler, retry, tracing
 
 _M = metrics.registry("reduction_worker")
 _TR = tracing.tracer("reduction_worker")
@@ -139,7 +139,8 @@ class ReductionWorker:
                 send_frame(sock, {
                     "daemon": "reduction_worker",
                     "spans": tracing.all_span_snapshots(),
-                    "ledger": device_ledger.events_snapshot()})
+                    "ledger": device_ledger.events_snapshot(),
+                    "counters": profiler.counters_snapshot()})
             else:
                 send_frame(sock, {"error": "NoSuchOp", "message": str(op)})
         except (ConnectionError, OSError):
@@ -415,8 +416,13 @@ class WorkerClient:
             try:
                 dl.check("worker reduce")
                 s.settimeout(dl.timeout())
-                dt.write_packet(s, seq, b"", last=True)
-                resp = self._checked(recv_frame(s))
+                # the final drain IS the wait on device compute: the worker
+                # answers only after its TPU reduce completes, so the DN-side
+                # timeline books it as device_wait (its own ledger records
+                # nothing — the dispatches live in the worker process)
+                with profiler.phase("device_wait"):
+                    dt.write_packet(s, seq, b"", last=True)
+                    resp = self._checked(recv_frame(s))
             except (OSError, ConnectionError) as e:
                 raise WorkerError(f"worker failed: {e}") from e
             cuts = np.frombuffer(resp["cuts"], np.int64)

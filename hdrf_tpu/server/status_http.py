@@ -8,8 +8,10 @@ opts into via ``status_port`` config, serving
 - ``/prom``    — Prometheus text exposition over this process's registries
   (utils/prom.py; the PrometheusMetricsSink analog),
 - ``/metrics`` — raw JSON registry snapshots (the /jmx analog),
-- ``/traces``  — this process's finished spans + device-ledger events
-  (raw JSON; the gateway's /traces merges these across daemons),
+- ``/traces``  — this process's finished spans + device-ledger events +
+  profiler counter-track samples (raw JSON; ``?format=chrome`` renders
+  Perfetto JSON with counter tracks; the gateway's /traces merges these
+  across daemons),
 - ``/stacks``  — live thread stacks plus the watchdog's recent stall
   captures (the HttpServer2 StackServlet analog).
 
@@ -23,7 +25,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from hdrf_tpu.utils import device_ledger, metrics, prom, tracing
+from hdrf_tpu.utils import device_ledger, metrics, profiler, prom, tracing
 from hdrf_tpu.utils.watchdog import StallWatchdog, thread_stacks
 
 
@@ -61,9 +63,10 @@ class StatusHttpServer:
                 if u.path == "/traces":
                     out = status.traces(trace_id=q.get("trace_id"))
                     if q.get("format") == "chrome":
-                        out = tracing.chrome_trace(out["spans"],
-                                                   out["ledger"],
-                                                   trace_id=q.get("trace_id"))
+                        out = tracing.chrome_trace(
+                            out["spans"], out["ledger"],
+                            trace_id=q.get("trace_id"),
+                            counters=out.get("counters", []))
                     return self._send(200, json.dumps(out).encode(),
                                       "application/json")
                 if u.path == "/stacks":
@@ -93,10 +96,13 @@ class StatusHttpServer:
     def traces(self, trace_id: str | None = None) -> dict:
         spans = tracing.all_span_snapshots()
         ledger = device_ledger.events_snapshot()
+        counters = profiler.counters_snapshot()
         if trace_id is not None:
             spans = [s for s in spans if s["trace_id"] == trace_id]
             ledger = [e for e in ledger if e.get("trace_id") == trace_id]
-        return {"daemon": self.name, "spans": spans, "ledger": ledger}
+            counters = []  # counter samples have no trace affinity
+        return {"daemon": self.name, "spans": spans, "ledger": ledger,
+                "counters": counters}
 
     def stacks(self) -> dict:
         out = {"daemon": self.name, "threads": thread_stacks()}

@@ -15,6 +15,14 @@ mechanism re-expressed by utils/fault_injection.py): every
 referenced by at least one test under ``tests/`` — an unexercised crash
 window is a crash window nobody has proven survivable.
 
+Third lint: the /prom metric contract.  Every metric name declared with a
+plain string literal through a registry's incr / observe / gauge / time
+call must appear backticked in ARCHITECTURE.md's metrics table — an
+undocumented gauge is a dashboard nobody can interpret.
+Dynamic (f-string) names are exempt by construction — their FAMILIES must
+be documented under the base name instead (e.g. ``phase_us``,
+``wait_us``), which the tests pin.
+
 Run as ``python -m hdrf_tpu.tools.check_parity`` (exit 1 on violations);
 wired as tier-1 tests in tests/test_tools.py.
 """
@@ -35,6 +43,12 @@ CITATION = re.compile(
 # fault_injection.point("name", ...) declarations in main code
 FAULT_POINT = re.compile(
     r"fault_injection\.point\(\s*['\"]([A-Za-z0-9_.]+)['\"]")
+
+# Plain-string metric declarations.  f-string names (per-phase/per-op
+# families like f"wait_us|op={op}") never match: the ``f`` prefix sits
+# between the open paren and the quote, which ``\s*`` rejects.
+METRIC_CALL = re.compile(
+    r"\.(?:incr|observe|gauge|time)\(\s*['\"]([A-Za-z0-9_.|=]+)['\"]")
 
 
 def check(root: str) -> list[str]:
@@ -97,15 +111,51 @@ def check_fault_points(root: str, tests_dir: str | None = None) -> list[str]:
     return problems
 
 
+def declared_metrics(root: str) -> dict[str, str]:
+    """Every plain-literal metric name declared under ``root`` -> first
+    declaring file.  Keys keep any ``|label=value`` suffix; the documented
+    unit is the base name (``key.split("|")[0]``)."""
+    names: dict[str, str] = {}
+    for dirpath, _dirs, files in sorted(os.walk(root)):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            src = open(path, encoding="utf-8").read()
+            for name in METRIC_CALL.findall(src):
+                names.setdefault(name,
+                                 os.path.relpath(path,
+                                                 os.path.dirname(root)))
+    return names
+
+
+def check_prom_metrics(root: str, arch_md: str | None = None) -> list[str]:
+    """Return one message per metric name absent from ARCHITECTURE.md's
+    metrics table (matched as a backticked base name)."""
+    if arch_md is None:
+        arch_md = os.path.join(os.path.dirname(root), "ARCHITECTURE.md")
+    text = ""
+    if os.path.isfile(arch_md):
+        text = open(arch_md, encoding="utf-8").read()
+    problems = []
+    for name, where in sorted(declared_metrics(root).items()):
+        base = name.split("|")[0]
+        if f"`{base}`" not in text:
+            problems.append(f"metric '{base}' ({where}) is not documented "
+                            f"in {os.path.basename(arch_md)}")
+    return problems
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     root = argv[0] if argv else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    problems = check(root) + check_fault_points(root)
+    problems = (check(root) + check_fault_points(root)
+                + check_prom_metrics(root))
     for p in problems:
         print(p)
     print(f"{len(problems)} violation(s)" if problems
-          else "parity citations + fault-point coverage: clean")
+          else "parity citations + fault-point coverage + metric docs: clean")
     return 1 if problems else 0
 
 

@@ -18,7 +18,9 @@ bounded event ring:
   shape key also counts ``compiles_total`` — the jit-cache-miss approximation).
 - ``readback(token, ...)``: the forced completion of a prior dispatch
   (``readback_total``/``d2h_bytes_total``; histogram ``wait_us`` measures
-  enqueue->forced-completion wall time; waits beyond the stall budget bump
+  enqueue->forced-completion wall time, and the per-op family
+  ``wait_us|op=<op>`` splits it by dispatch label on /prom; waits beyond
+  the stall budget bump
   ``stall_total`` — the ~100 ms/dispatch norm vs the ~35 s VM stalls).
 - ``transfer(kind, op, nbytes)``: a bare H2D/D2H copy with no compute
   (``h2d_bytes_total``/``d2h_bytes_total`` and a per-kind event).
@@ -37,7 +39,7 @@ import time
 from collections import deque
 from typing import Any
 
-from . import metrics, tracing
+from . import metrics, profiler, tracing
 
 _M = metrics.registry("device_ledger")
 
@@ -56,18 +58,22 @@ _PROC = f"{os.path.basename(sys.argv[0] or 'py')}:{os.getpid()}"
 class _Pending:
     """Timing token returned by dispatch(); closed by readback()."""
 
-    __slots__ = ("op", "t0_wall", "t0", "batch", "h2d")
+    __slots__ = ("op", "t0_wall", "t0", "batch", "h2d", "counted")
 
-    def __init__(self, op: str, batch: int, h2d: int) -> None:
+    def __init__(self, op: str, batch: int, h2d: int,
+                 counted: bool = True) -> None:
         self.op = op
         self.t0_wall = time.time()
         self.t0 = time.perf_counter()
         self.batch = batch
         self.h2d = h2d
+        # Only counted tokens moved the outstanding-dispatches counter track
+        # up at dispatch(); pending() tokens must not move it down.
+        self.counted = counted
 
 
 def _event(op: str, kind: str, *, t0: float, dur_us: float, batch: int,
-           nbytes: int) -> None:
+           nbytes: int) -> int:
     ctx = tracing.current_context()
     ev = {
         "proc": _PROC, "op": op, "kind": kind, "t0": t0,
@@ -79,6 +85,7 @@ def _event(op: str, kind: str, *, t0: float, dur_us: float, batch: int,
         _next_id[0] += 1
         ev["id"] = _next_id[0]
         _ring.append(ev)
+    return ev["id"]
 
 
 def dispatch(op: str, *, batch: int = 1, h2d_bytes: int = 0,
@@ -106,13 +113,14 @@ def dispatch(op: str, *, batch: int = 1, h2d_bytes: int = 0,
     # awaited boundary than the XLA prep -> host-select -> SHA shape).
     _event(op, "enqueue", t0=time.time(), dur_us=0.0, batch=batch,
            nbytes=h2d_bytes)
+    profiler.note_device_dispatch()
     return _Pending(op, batch, h2d_bytes)
 
 
 def pending(op: str, *, batch: int = 1) -> _Pending:
     """Timing token WITHOUT counting a dispatch — for aggregate readbacks
     whose constituent dispatches were already recorded individually."""
-    return _Pending(op, batch, 0)
+    return _Pending(op, batch, 0, counted=False)
 
 
 def readback(tok: _Pending | None, *, d2h_bytes: int = 0) -> None:
@@ -126,12 +134,15 @@ def readback(tok: _Pending | None, *, d2h_bytes: int = 0) -> None:
     if d2h_bytes:
         _M.incr("d2h_bytes_total", d2h_bytes)
     _M.observe("wait_us", dur * 1e6)
+    _M.observe(f"wait_us|op={tok.op}", dur * 1e6)
     if dur > STALL_BUDGET_S:
         _M.incr("stall_total")
         _event(tok.op, "stall", t0=tok.t0_wall, dur_us=dur * 1e6,
                batch=tok.batch, nbytes=d2h_bytes)
-    _event(tok.op, "dispatch", t0=tok.t0_wall, dur_us=dur * 1e6,
-           batch=tok.batch, nbytes=tok.h2d + d2h_bytes)
+    ev_id = _event(tok.op, "dispatch", t0=tok.t0_wall, dur_us=dur * 1e6,
+                   batch=tok.batch, nbytes=tok.h2d + d2h_bytes)
+    profiler.note_device_wait(tok.op, tok.t0_wall, tok.t0_wall + dur,
+                              event_id=ev_id, counted=tok.counted)
 
 
 def transfer(kind: str, op: str, nbytes: int) -> None:

@@ -12,6 +12,11 @@ registry name as a label.  Conventions:
 - histograms-> ``hdrf_<key>_bucket{registry="r",le="<bound>"}`` CUMULATIVE
   counts (utils/metrics.py Histogram.snapshot), ``le="+Inf"`` == ``_count``,
   plus ``_sum`` and ``_count`` series.
+- a metric key may carry a ``|k=v,k2=v2`` label suffix (e.g. the device
+  ledger's per-op ``wait_us|op=sha256`` or the profiler's
+  ``phase_us|phase=wal_commit``): the part before ``|`` names the family,
+  the pairs become extra labels after ``registry`` — so labeled series
+  share one family with their unlabeled aggregate.
 
 One ``# TYPE`` line per family name across ALL registries (the format forbids
 repeats), so same-named metrics from different registries share a family and
@@ -38,6 +43,19 @@ def _fmt(v: float) -> str:
     return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
+def _split_key(key: str) -> tuple[str, str]:
+    """Split ``base|k=v,k2=v2`` into (base, rendered extra labels)."""
+    if "|" not in key:
+        return key, ""
+    base, _, rest = key.partition("|")
+    parts = []
+    for pair in rest.split(","):
+        k, _, v = pair.partition("=")
+        v = v.replace("\\", "\\\\").replace('"', '\\"')
+        parts.append(f'{_SAN.sub("_", k)}="{v}"')
+    return base, "," + ",".join(parts)
+
+
 def render(snapshots: dict[str, Any]) -> str:
     """Render ``metrics.all_snapshots()``-shaped dicts as exposition text."""
     families: dict[str, tuple[str, list[str]]] = {}
@@ -51,23 +69,27 @@ def render(snapshots: dict[str, Any]) -> str:
     for reg_name, snap in sorted(snapshots.items()):
         lbl = f'registry="{_SAN.sub("_", reg_name)}"'
         for key, v in sorted(snap.get("counters", {}).items()):
-            base = _name(key)
+            raw, extra = _split_key(key)
+            base = _name(raw)
             if not base.endswith("_total"):
                 base += "_total"
-            fam(base, "counter").append(f"{base}{{{lbl}}} {_fmt(v)}")
+            fam(base, "counter").append(f"{base}{{{lbl}{extra}}} {_fmt(v)}")
         for key, v in sorted(snap.get("gauges", {}).items()):
-            base = _name(key)
-            fam(base, "gauge").append(f"{base}{{{lbl}}} {_fmt(v)}")
+            raw, extra = _split_key(key)
+            base = _name(raw)
+            fam(base, "gauge").append(f"{base}{{{lbl}{extra}}} {_fmt(v)}")
         for key, h in sorted(snap.get("histograms", {}).items()):
-            base = _name(key)
+            raw, extra = _split_key(key)
+            base = _name(raw)
             rows = fam(base, "histogram")
             for bound, cum in h.get("buckets", []):
-                rows.append(f'{base}_bucket{{{lbl},le="{_fmt(bound)}"}} '
-                            f"{_fmt(cum)}")
-            rows.append(f'{base}_bucket{{{lbl},le="+Inf"}} '
+                rows.append(
+                    f'{base}_bucket{{{lbl}{extra},le="{_fmt(bound)}"}} '
+                    f"{_fmt(cum)}")
+            rows.append(f'{base}_bucket{{{lbl}{extra},le="+Inf"}} '
                         f"{_fmt(h['count'])}")
-            rows.append(f"{base}_sum{{{lbl}}} {_fmt(h.get('sum', 0.0))}")
-            rows.append(f"{base}_count{{{lbl}}} {_fmt(h['count'])}")
+            rows.append(f"{base}_sum{{{lbl}{extra}}} {_fmt(h.get('sum', 0.0))}")
+            rows.append(f"{base}_count{{{lbl}{extra}}} {_fmt(h['count'])}")
 
     out: list[str] = []
     for name, (ptype, rows) in sorted(families.items()):

@@ -136,17 +136,23 @@ def all_span_snapshots() -> list[dict[str, Any]]:
 
 def chrome_trace(spans: list[dict[str, Any]],
                  ledger: list[dict[str, Any]] = (),
-                 trace_id: str | None = None) -> dict[str, Any]:
-    """Assemble span snapshots + device-ledger events into Chrome
-    ``trace_event`` format (the ``chrome://tracing`` / Perfetto JSON schema:
-    ``M`` process-name metadata rows plus ``X`` complete events with
-    microsecond ``ts``/``dur``).  ``pid`` groups rows by tracer (spans) or
-    originating process (ledger events); ``tid`` groups by trace so one
-    write's causal chain reads as one row block.  ``args`` keeps the raw
-    trace/span/parent ids, so parent-chain assembly survives the export."""
+                 trace_id: str | None = None,
+                 counters: list[dict[str, Any]] = ()) -> dict[str, Any]:
+    """Assemble span snapshots + device-ledger events + profiler counter
+    samples into Chrome ``trace_event`` format (the ``chrome://tracing`` /
+    Perfetto JSON schema: ``M`` process-name metadata rows plus ``X``
+    complete events with microsecond ``ts``/``dur``, plus ``C`` counter
+    events rendered as Perfetto counter tracks — in-flight blocks,
+    outstanding dispatches, WAL queue depth from utils/profiler.py).
+    ``pid`` groups rows by tracer (spans) or originating process (ledger
+    events / counter tracks); ``tid`` groups by trace so one write's causal
+    chain reads as one row block.  ``args`` keeps the raw trace/span/parent
+    ids, so parent-chain assembly survives the export.  Counter samples have
+    no trace affinity, so a ``trace_id`` filter drops them."""
     if trace_id is not None:
         spans = [s for s in spans if s["trace_id"] == trace_id]
         ledger = [e for e in ledger if e.get("trace_id") == trace_id]
+        counters = []
     events: list[dict[str, Any]] = []
     pids: dict[str, int] = {}
 
@@ -181,5 +187,12 @@ def chrome_trace(spans: list[dict[str, Any]],
             "args": {"trace_id": e.get("trace_id"),
                      "span_id": e.get("span_id"), "batch": e.get("batch"),
                      "bytes": e.get("bytes"), "kind": e["kind"]},
+        })
+    for c in counters:
+        events.append({
+            "ph": "C", "name": c["name"], "cat": "profiler",
+            "pid": pid_of(f"profiler:{c.get('proc', '?')}"), "tid": 0,
+            "ts": c["t"] * 1e6,
+            "args": {"value": c["value"]},
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -13,6 +13,12 @@ Budgets target the environment's two known pathologies (PERF_NOTES.md): the
 ~35 s VM write-burst stalls and device dispatches far over the ~100 ms norm.
 Each stalled op is flagged ONCE (re-flagged only if still running after
 another full budget), so a 35 s stall counts as one stall, not 35/tick.
+
+Stall records carry the tracked op's active trace id (captured at track()
+entry) and the phase the op's thread is in at flag time (utils/profiler.py
+per-thread phase stacks), and a synthetic ``stall`` span lands in the
+``watchdog`` tracer — so a VM stall is attributable to a specific
+block/phase in /stacks AND visible on the chrome export timeline.
 """
 
 from __future__ import annotations
@@ -26,7 +32,7 @@ import traceback
 from collections import deque
 from typing import Any, Iterator
 
-from . import fault_injection, log, metrics
+from . import fault_injection, log, metrics, profiler, tracing
 
 DEFAULT_BUDGET_S = float(os.environ.get("HDRF_STALL_BUDGET_S", "30.0"))
 
@@ -82,9 +88,11 @@ class StallWatchdog:
     def track(self, op: str, budget_s: float | None = None) -> Iterator[None]:
         """Wrap an operation; the scan thread flags it if it outlives its
         budget.  Zero-cost beyond one dict insert/remove."""
+        ctx = tracing.current_context()
         ent = {"op": op, "t0": time.monotonic(),
                "budget": budget_s if budget_s is not None else self.budget_s,
-               "flagged": 0.0, "thread": threading.get_ident()}
+               "flagged": 0.0, "thread": threading.get_ident(),
+               "trace_id": None if ctx is None else f"{ctx[0]:016x}"}
         with self._lock:
             self._next += 1
             key = self._next
@@ -113,19 +121,46 @@ class StallWatchdog:
                     stalled.append(dict(ent))
         for ent in stalled:
             elapsed = now - ent["t0"]
+            # phase the stalled op's thread is in RIGHT NOW (cross-thread
+            # probe — the scan thread is not the stalled thread)
+            phase = profiler.thread_phase(ent.get("thread"))
             self._reg.incr("stall_total")
             rec = {"ts": time.time(), "daemon": self.name, "op": ent["op"],
                    "elapsed_s": round(elapsed, 3),
                    "budget_s": ent["budget"],
+                   "trace_id": ent.get("trace_id"), "phase": phase,
                    "stacks": thread_stacks()}
             with self._lock:
                 self._stalls.append(rec)
             self._log.warning("stall", op=ent["op"],
                               elapsed_s=round(elapsed, 3),
-                              budget_s=ent["budget"])
+                              budget_s=ent["budget"],
+                              trace_id=ent.get("trace_id"), phase=phase)
             fault_injection.point("watchdog.stall", daemon=self.name,
-                                  op=ent["op"], elapsed_s=elapsed)
+                                  op=ent["op"], elapsed_s=elapsed,
+                                  trace_id=ent.get("trace_id"), phase=phase)
+            self._stall_span(ent, elapsed, phase)
         return len(stalled)
+
+    def _stall_span(self, ent: dict[str, Any], elapsed: float,
+                    phase: str | None) -> None:
+        """Synthetic span covering the stalled window so the stall shows up
+        on the chrome-export timeline next to the block's other spans
+        (same trace id when the op carried one)."""
+        try:
+            tid = (int(ent["trace_id"], 16) if ent.get("trace_id")
+                   else tracing._rand_id())
+            sp = tracing.Span(tid, tracing._rand_id(), 0,
+                              f"stall:{ent['op']}",
+                              tracer=tracing.tracer("watchdog"),
+                              t0=time.time() - elapsed)
+            sp.annotate("daemon", self.name)
+            sp.annotate("elapsed_s", round(elapsed, 3))
+            if phase is not None:
+                sp.annotate("phase", phase)
+            sp.finish()
+        except (ValueError, TypeError):
+            pass  # malformed trace id: the rec/log/fault still carry it
 
     # ------------------------------------------------------------ introspect
 

@@ -85,18 +85,23 @@ def check_prom(text: str):
         if types[fam] == "counter":
             assert name.endswith("_total"), f"counter {name} missing _total"
         if types[fam] == "histogram" and name.endswith("_bucket"):
-            key = (fam, labels.get("registry"))
+            # key on the FULL label set minus le — per-op/per-phase series
+            # (wait_us{op=...}, phase_us{phase=...}) are distinct histograms
+            # sharing one family
+            key = (fam, tuple(sorted((k, v) for k, v in labels.items()
+                                     if k != "le")))
             hist_series.setdefault(key, []).append(
                 (float("inf") if labels["le"] == "+Inf" else float(labels["le"]),
                  val))
-    for (fam, reg), rows in hist_series.items():
+    for (fam, lab_key), rows in hist_series.items():
         rows.sort()
         cums = [v for _, v in rows]
-        assert cums == sorted(cums), f"{fam}{{{reg}}} buckets not cumulative"
+        assert cums == sorted(cums), f"{fam}{{{lab_key}}} buckets not cumulative"
         count = next(v for n, lab, v in samples
-                     if n == f"{fam}_count" and lab.get("registry") == reg)
+                     if n == f"{fam}_count"
+                     and tuple(sorted(lab.items())) == lab_key)
         assert rows[-1][0] == float("inf") and rows[-1][1] == count, \
-            f"{fam}{{{reg}}} +Inf bucket != _count"
+            f"{fam}{{{lab_key}}} +Inf bucket != _count"
     return types, samples
 
 
@@ -149,6 +154,25 @@ class TestPromRender:
         text = prom.render(metrics.all_snapshots())
         assert text.count("# TYPE hdrf_shared_ops_total counter") == 1
         check_prom(text)
+
+    def test_label_suffix_keys_render_as_labels(self):
+        """``name|k=v`` keys (per-op wait_us, per-phase phase_us) render as
+        extra labels on the BASE family — one # TYPE, distinct series."""
+        reg = metrics.registry("obs_prom_lbl")
+        for v in (10, 20):
+            reg.observe("io_us", v)
+            reg.observe("io_us|op=cdc", v)
+            reg.observe("io_us|op=sha", 2 * v)
+        reg.incr("ops|op=cdc")
+        text = prom.render(metrics.all_snapshots())
+        types, samples = check_prom(text)
+        assert text.count("# TYPE hdrf_io_us histogram") == 1
+        ops = {lab.get("op") for n, lab, _ in samples
+               if n == "hdrf_io_us_count"
+               and lab.get("registry") == "obs_prom_lbl"}
+        assert ops == {None, "cdc", "sha"}
+        assert any(n == "hdrf_ops_total" and lab.get("op") == "cdc"
+                   for n, lab, _ in samples)
 
 
 class TestLedger:
@@ -434,3 +458,17 @@ class TestBenchContract:
         # fell back mid-bench (either would taint the throughput verdict)
         assert int(doc["resilience"]["breaker_open_total"]) == 0
         assert int(doc["resilience"]["degraded_writes"]) == 0
+        # write-path phase profile: the e2e window decomposed into the
+        # profiler's exclusive classes (sums to wall within rounding) with
+        # the overlap ratios alongside
+        pp = doc["phase_profile"]
+        assert set(pp["classes"]) == {"host_busy", "device_busy",
+                                      "transport_wait", "idle"}
+        assert pp["wall_s"] > 0
+        assert abs(sum(pp["classes"].values()) - pp["wall_s"]) < 0.005
+        assert 0.0 <= pp["overlap_efficiency"] <= 1.0
+        assert 0.0 <= pp["attributed_frac"] <= 1.0
+        # the smoke e2e pass runs real CDC+SHA + WAL commits: both phases
+        # must have been attributed some exclusive time
+        assert pp["phases"].get("reduce_compute", 0) > 0
+        assert pp["phases"].get("wal_commit", 0) > 0

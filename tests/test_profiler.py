@@ -1,0 +1,362 @@
+"""Write-path critical-path profiler (utils/profiler.py) + gap-attribution
+report (tools/gap_report.py): the decomposition the reference never had
+(DataNodeMetrics.java:553-560 stops at per-op rate counters).
+
+Partition math on injected integer clocks (exact sums — the idle remainder
+makes the class partition total the wall clock by construction), timeline
+assembly through the ambient contextvar, device-ledger linkage
+(dispatch/readback ids landing on the open timeline), the watchdog's
+cross-thread phase attribution, the gap_report golden table, and the
+MiniCluster end-to-end acceptance bar (>= 95% of write wall attributed)."""
+
+import json
+import threading
+
+import pytest
+
+from hdrf_tpu.tools import gap_report
+from hdrf_tpu.utils import device_ledger, fault_injection, profiler, tracing
+
+W = profiler.profile_spans
+
+
+def approx(a, b, tol=1e-9):
+    return abs(a - b) < tol
+
+
+# ------------------------------------------------------- overlap accountant
+
+
+class TestPartition:
+    def test_empty_window_is_all_idle(self):
+        p = W([], 0.0, 10.0)
+        assert p["wall_s"] == 10.0
+        assert p["classes"] == {"host_busy": 0.0, "device_busy": 0.0,
+                                "transport_wait": 0.0, "idle": 10.0}
+        assert p["attributed_frac"] == 0.0
+        assert p["overlap_efficiency"] == 1.0  # nothing to hide
+
+    def test_serial_phases_sum_exactly(self):
+        spans = [("recv", 0, 3), ("wal_commit", 3, 5), ("device_wait", 5, 9)]
+        p = W(spans, 0, 10)
+        assert p["classes"]["transport_wait"] == 3
+        assert p["classes"]["host_busy"] == 2
+        assert p["classes"]["device_busy"] == 4
+        assert p["classes"]["idle"] == 1
+        assert sum(p["classes"].values()) == p["wall_s"] == 10
+        assert p["phases"] == {"recv": 3, "wal_commit": 2, "device_wait": 4}
+        assert approx(p["attributed_frac"], 0.9)
+
+    def test_hidden_wait_and_efficiency(self):
+        # recv [0,4), device [2,8), wal [6,10): the canonical overlap case
+        spans = [("recv", 0, 4), ("device_wait", 2, 8), ("wal_commit", 6, 10)]
+        p = W(spans, 0, 12)
+        assert p["classes"] == {"host_busy": 4.0, "device_busy": 4.0,
+                                "transport_wait": 2.0, "idle": 2.0}
+        # hideable = any device/transport active = [0,8) = 8;
+        # hidden = host concurrently busy = [6,8) = 2
+        assert p["hideable_wait_s"] == 8 and p["hidden_wait_s"] == 2
+        assert approx(p["overlap_efficiency"], 0.25)
+        assert p["phases"] == {"recv": 2.0, "device_wait": 4.0,
+                               "wal_commit": 4.0}
+        assert sum(p["classes"].values()) == 12.0
+
+    def test_class_priority_host_over_device_over_transport(self):
+        spans = [("recv", 0, 6), ("device_wait", 0, 4), ("checksum", 0, 2)]
+        p = W(spans, 0, 6)
+        # [0,2) host wins; [2,4) device wins; [4,6) transport remains
+        assert p["classes"]["host_busy"] == 2
+        assert p["classes"]["device_busy"] == 2
+        assert p["classes"]["transport_wait"] == 2
+        assert p["phases"] == {"checksum": 2.0, "device_wait": 2.0,
+                               "recv": 2.0}
+        # full overlap of waits by time, but only [0,2) of the 6 hideable
+        # seconds sat under host work
+        assert p["hideable_wait_s"] == 6 and p["hidden_wait_s"] == 2
+
+    def test_unknown_phase_defaults_to_host(self):
+        assert profiler.phase_class("weird_new_phase") == profiler.HOST
+        p = W([("weird_new_phase", 0, 2)], 0, 2)
+        assert p["classes"]["host_busy"] == 2
+        assert p["phases"] == {"weird_new_phase": 2.0}
+
+    def test_spans_clamped_to_window(self):
+        p = W([("recv", -5, 3), ("wal_commit", 8, 20)], 0, 10)
+        assert p["classes"]["transport_wait"] == 3
+        assert p["classes"]["host_busy"] == 2
+        assert p["phases"] == {"recv": 3.0, "wal_commit": 2.0}
+        assert sum(p["classes"].values()) == 10.0
+
+    def test_bytes_rate(self):
+        p = W([("recv", 0, 1)], 0, 2, nbytes=4 << 20)
+        assert p["bytes"] == 4 << 20 and approx(p["mb_per_s"], 2.0)
+
+
+# -------------------------------------------------------- timeline assembly
+
+
+class _Clock:
+    """Settable wall clock injected over profiler._now."""
+
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestTimelineAssembly:
+    def test_phases_land_on_ambient_timeline(self, monkeypatch):
+        profiler.reset()
+        clk = _Clock()
+        monkeypatch.setattr(profiler, "_now", clk)
+        assert profiler.current_timeline() is None
+        with profiler.block_timeline(7, nbytes=123) as tl:
+            assert profiler.current_timeline() is tl
+            with profiler.phase("wal_commit"):
+                clk.t += 2
+            clk.t += 1
+            with profiler.phase("recv"):
+                clk.t += 3
+        assert profiler.current_timeline() is None
+        assert tl.t0 == 100.0 and tl.t1 == 106.0
+        assert tl.spans == [("wal_commit", 100.0, 102.0, tl.spans[0][3]),
+                            ("recv", 103.0, 106.0, tl.spans[1][3])]
+        prof = tl.profile()
+        assert prof["classes"] == {"host_busy": 2.0, "transport_wait": 3.0,
+                                   "device_busy": 0.0, "idle": 1.0}
+        assert approx(prof["attributed_frac"], 5.0 / 6.0)
+        snap = profiler.timelines_snapshot()[-1]
+        assert snap["block_id"] == 7 and snap["nbytes"] == 123
+        assert snap["spans"] == [["wal_commit", 100.0, 102.0],
+                                 ["recv", 103.0, 106.0]]
+        assert snap["profile"]["wall_s"] == 6.0
+
+    def test_finished_timeline_observes_registry(self, monkeypatch):
+        profiler.reset()
+        clk = _Clock()
+        monkeypatch.setattr(profiler, "_now", clk)
+        from hdrf_tpu.utils import metrics
+        reg = metrics.registry("write_profiler")
+        before = reg.counter("blocks_profiled")
+        with profiler.block_timeline(1):
+            with profiler.phase("container_io"):
+                clk.t += 1
+        assert reg.counter("blocks_profiled") == before + 1
+        snap = reg.snapshot()
+        assert snap["gauges"]["attributed_frac"] == 1.0
+        assert "phase_us|phase=container_io" in snap["histograms"]
+
+    def test_timed_iter_records_per_item_spans(self, monkeypatch):
+        profiler.reset()
+        clk = _Clock()
+        monkeypatch.setattr(profiler, "_now", clk)
+
+        def slow_src():
+            for i in range(3):
+                clk.t += 2  # the wait happens inside next()
+                yield i
+
+        with profiler.block_timeline(2) as tl:
+            items = list(profiler.timed_iter("recv", slow_src()))
+        assert items == [0, 1, 2]
+        recv = [s for s in tl.spans if s[0] == "recv"]
+        assert len(recv) == 3
+        assert all(s[2] - s[1] == 2.0 for s in recv)
+        assert tl.profile()["classes"]["transport_wait"] == 6.0
+
+    def test_window_profile_sees_other_threads(self, monkeypatch):
+        profiler.reset()
+        t0 = profiler.mark()
+
+        def worker():
+            with profiler.phase("wal_commit"):
+                pass
+
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+        prof = profiler.window_profile(t0, profiler.mark())
+        assert "wal_commit" in prof["phases"]
+
+
+# ------------------------------------------------------- device-ledger link
+
+
+class TestLedgerLinkage:
+    def test_dispatch_readback_lands_on_timeline(self):
+        profiler.reset()
+        with profiler.block_timeline(11) as tl:
+            tok = device_ledger.dispatch("prof.unit", batch=2,
+                                         h2d_bytes=64, key=("prof-unit", 2))
+            device_ledger.readback(tok, d2h_bytes=16)
+        assert len(tl.ledger_ids) == 1
+        evs = {e["id"]: e for e in device_ledger.events_snapshot()}
+        ev = evs[tl.ledger_ids[0]]
+        assert ev["op"] == "prof.unit" and ev["kind"] == "dispatch"
+        waits = [s for s in tl.spans if s[0] == "device_wait"]
+        assert len(waits) == 1
+        assert tl.profile()["classes"]["device_busy"] >= 0.0
+
+    def test_outstanding_dispatches_track_balances(self):
+        profiler.reset()
+        tok = device_ledger.dispatch("prof.track", batch=1)
+        names = {(s["name"], s["value"])
+                 for s in profiler.counters_snapshot()}
+        assert ("outstanding_dispatches", 1.0) in names
+        device_ledger.readback(tok)
+        last = [s for s in profiler.counters_snapshot()
+                if s["name"] == "outstanding_dispatches"][-1]
+        assert last["value"] == 0.0
+        # aggregate (pending) tokens must NOT decrement below zero
+        device_ledger.readback(device_ledger.pending("prof.track"))
+        last = [s for s in profiler.counters_snapshot()
+                if s["name"] == "outstanding_dispatches"][-1]
+        assert last["value"] == 0.0
+
+    def test_counter_samples_render_as_chrome_counter_events(self):
+        profiler.reset()
+        profiler.counter_set("wal_queue_depth", 3)
+        doc = tracing.chrome_trace([], counters=profiler.counters_snapshot())
+        cevs = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+        assert any(e["name"] == "wal_queue_depth"
+                   and e["args"]["value"] == 3 for e in cevs)
+
+
+# ------------------------------------------- watchdog phase/trace attribution
+
+
+class TestWatchdogAttribution:
+    def test_stall_record_carries_phase_and_trace(self):
+        from hdrf_tpu.utils.watchdog import StallWatchdog
+        wd = StallWatchdog("prof_wd", budget_s=5.0, tick_s=999.0)
+        seen = {}
+
+        def on_stall(**kw):
+            seen.update(kw)
+
+        import time as _time
+        tr = tracing.tracer("prof_wd_client")
+        with tr.span("client.write") as root:
+            with wd.track("xceiver.write"):
+                with profiler.phase("container_io"):
+                    with fault_injection.inject("watchdog.stall", on_stall):
+                        n = wd.scan(now=_time.monotonic() + 100.0)
+        assert n == 1
+        tid = f"{root.trace_id:016x}"
+        rec = wd.stalls()[-1]
+        assert rec["phase"] == "container_io"
+        assert rec["trace_id"] == tid
+        assert seen["phase"] == "container_io" and seen["trace_id"] == tid
+        # synthetic stall span joined the watchdog tracer under the same
+        # trace id (visible next to the block's spans in a chrome export)
+        spans = tracing.tracer("watchdog").snapshot()
+        mine = [s for s in spans if s["trace_id"] == tid]
+        assert mine and mine[-1]["name"] == "stall:xceiver.write"
+        assert mine[-1]["annotations"]["phase"] == "container_io"
+
+    def test_thread_phase_probe(self):
+        assert profiler.thread_phase() is None
+        with profiler.phase("checksum"):
+            with profiler.phase("container_io"):
+                assert profiler.thread_phase() == "container_io"
+            assert profiler.thread_phase() == "checksum"
+        assert profiler.thread_phase() is None
+
+
+# ------------------------------------------------------- gap_report goldens
+
+
+def _golden_timelines():
+    spans = [["recv", 0.0, 4.0], ["device_wait", 2.0, 8.0],
+             ["wal_commit", 6.0, 10.0]]
+    tl = {"block_id": 1, "nbytes": 8 << 20, "t0": 0.0, "t1": 12.0,
+          "spans": spans, "ledger_ids": [],
+          "profile": profiler.profile_spans(
+              [tuple(s) for s in spans], 0.0, 12.0, nbytes=8 << 20)}
+    return [tl]
+
+
+class TestGapReport:
+    def test_aggregate_golden(self):
+        agg = gap_report.aggregate(_golden_timelines())
+        assert agg["blocks"] == 1 and agg["bytes"] == 8 << 20
+        assert agg["wall_s"] == 12.0
+        assert approx(agg["attributed_frac"], 10.0 / 12.0)
+        assert approx(agg["overlap_efficiency"], 0.25)
+        rows = {r["phase"]: r for r in agg["phases"]}
+        assert rows["device_wait"]["exclusive_s"] == 4.0
+        # removing wal_commit's 4 exclusive seconds: 8 MiB / 8 s vs /12 s
+        assert approx(rows["wal_commit"]["lost_mb_per_s"],
+                      8.0 / 8.0 - 8.0 / 12.0)
+
+    def test_format_table_golden(self):
+        text = gap_report.format_table(gap_report.aggregate(
+            _golden_timelines()))
+        assert text == "\n".join([
+            "write path: 1 blocks, 8.00 MiB in 12.000 s = 0.7 MB/s",
+            "attributed: 83.3% of wall clock in named phase/overlap classes",
+            "overlap efficiency: 25.0% (2.000 s of 8.000 s wait hidden "
+            "under host work)",
+            "",
+            "class              seconds   share",
+            "host_busy            4.000   33.3%",
+            "device_busy          4.000   33.3%",
+            "transport_wait       2.000   16.7%",
+            "idle                 2.000   16.7%",
+            "",
+            "phase               excl s   share  lost MB/s",
+            "device_wait          4.000   33.3%        0.3",
+            "wal_commit           4.000   33.3%        0.3",
+            "recv                 2.000   16.7%        0.1",
+        ])
+
+    def test_main_json_over_input_file(self, tmp_path):
+        f = tmp_path / "tls.json"
+        f.write_text(json.dumps(_golden_timelines()))
+        import io
+        from contextlib import redirect_stdout
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = gap_report.main(["--input", str(f), "--json"])
+        assert rc == 0
+        agg = json.loads(buf.getvalue())
+        assert agg["blocks"] == 1 and approx(agg["overlap_efficiency"], 0.25)
+
+
+# ----------------------------------------------------------- end to end
+
+
+class TestE2E:
+    def test_minicluster_smoke_attribution_bar(self):
+        """The ISSUE acceptance gate: the gap_report smoke partitions
+        >= 95% of MiniCluster write wall clock into named classes."""
+        agg = gap_report.aggregate(gap_report.run_smoke())
+        assert agg["blocks"] == gap_report.SMOKE_BLOCKS
+        assert agg["attributed_frac"] >= 0.95, agg
+        # partition exactness survives aggregation
+        assert approx(sum(agg["classes"].values()), agg["wall_s"], tol=1e-6)
+        # the dedup write path must show its signature phases
+        rows = {r["phase"] for r in agg["phases"]}
+        assert {"recv", "wal_commit", "container_io",
+                "dedup_lookup"} <= rows
+
+    def test_minicluster_tpu_backend_links_ledger(self):
+        """A write through the jax reduction path (virtual-device mesh)
+        produces a timeline whose device_wait spans carry the ledger event
+        ids of the dispatches it waited on."""
+        from hdrf_tpu.testing.minicluster import MiniCluster
+        profiler.reset()
+        import random
+        payload = random.Random(5).randbytes(1 << 20)
+        with MiniCluster(n_datanodes=1, replication=1,
+                         block_size=1 << 20, backend="tpu") as mc:
+            with mc.client("prof-e2e") as c:
+                c.write("/prof/blk", payload, scheme="dedup")
+        tls = profiler.timelines_snapshot()
+        assert tls, "no timeline recorded for the write"
+        tl = tls[-1]
+        assert tl["ledger_ids"], "jax write produced no ledger links"
+        evs = {e["id"] for e in device_ledger.events_snapshot()}
+        assert set(tl["ledger_ids"]) <= evs
+        assert tl["profile"]["phases"].get("device_wait", 0) > 0
