@@ -290,6 +290,21 @@ def _phase_profile(t0: float, t1: float) -> dict:
     }
 
 
+def _pipeline_summary(phase_profile: dict) -> dict:
+    """Pipeline-depth stamp for the output line: the configured write
+    pipeline depth, WAL group-commit batches this run (chunk_index
+    registry), and the profile window's overlap efficiency."""
+    from hdrf_tpu.config import ReductionConfig
+    from hdrf_tpu.utils import metrics
+
+    counters = metrics.registry("chunk_index").snapshot()["counters"]
+    return {
+        "depth": ReductionConfig().pipeline_depth,
+        "group_commit_batches": int(counters.get("group_commit_batches", 0)),
+        "overlap_efficiency": phase_profile["overlap_efficiency"],
+    }
+
+
 def main() -> None:
     from hdrf_tpu.config import CdcConfig
     from hdrf_tpu.ops.dispatch import resolve_backend
@@ -338,6 +353,7 @@ def main() -> None:
                 "stalls": led.get("stall_total", 0),
                 "resilience": _resilience_summary(),
                 "phase_profile": phase_profile,
+                "pipeline": _pipeline_summary(phase_profile),
             }))
             return
 
@@ -662,6 +678,7 @@ def main() -> None:
             "stalls": led.get("stall_total", 0),
             "resilience": _resilience_summary(),
             "phase_profile": phase_profile,
+            "pipeline": _pipeline_summary(phase_profile),
         }))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

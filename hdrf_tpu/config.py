@@ -114,6 +114,18 @@ class ReductionConfig:
     # image staging; through a slow D2H transport the host path is faster
     # (measured — PERF_NOTES.md).
     device_recon: bool = False
+    # Async multi-block write pipeline (server/write_pipeline.py).
+    # pipeline_depth: how many in-flight blocks one shared device batch may
+    # coalesce (the ResidentReducer submit_many group bound); 1 = today's
+    # serial one-block-at-a-time path, every pipeline stage bypassed.
+    pipeline_depth: int = 4
+    # Bounded WAL group-commit window (ms): concurrent commit_block calls
+    # arriving within the window share ONE fsync (index/chunk_index.py).
+    # Only armed when pipeline_depth > 1; 0 disables grouping outright.
+    group_commit_window_ms: float = 2.0
+    # Admission bound on blocks simultaneously inside the pipeline
+    # (admitted-but-uncommitted); backpressures client streams beyond it.
+    pipeline_max_inflight: int = 8
     cdc: CdcConfig = field(default_factory=CdcConfig)
 
 

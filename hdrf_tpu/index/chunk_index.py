@@ -25,6 +25,13 @@ Durability discipline:
   monotonically increasing seqno and the checkpoint stores the last seqno it
   folded in; recovery skips WAL records <= that seqno, so a crash between
   checkpoint publish and WAL truncation cannot double-apply refcounts.
+- **Bounded group-commit window** (the FSEditLog.java:1648 ``logSync``
+  batching discipline): when armed (``group_window_s`` > 0), concurrent
+  ``commit_block`` callers elect a leader that waits up to the window (or
+  until ``group_max`` entries queue) and flushes the whole batch through
+  one WAL append + ONE fsync.  Each caller still returns only after its
+  record is durable AND applied — log-before-apply holds per block, and a
+  crash mid-window loses only blocks whose callers were never acked.
 """
 
 from __future__ import annotations
@@ -32,11 +39,14 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import time
 from dataclasses import dataclass
 
 import msgpack
 
-from hdrf_tpu.utils import fault_injection, profiler, wal as walmod
+from hdrf_tpu.utils import fault_injection, metrics, profiler, wal as walmod
+
+_M = metrics.registry("chunk_index")
 
 WAL_NAME = "index.wal"
 CKPT_NAME = "index.ckpt"
@@ -63,10 +73,23 @@ class BlockEntry:
     hashes: list[bytes]
 
 
+class _GCEntry:
+    """One caller's block parked in the group-commit window."""
+
+    __slots__ = ("block", "done", "losers", "exc")
+
+    def __init__(self, block: tuple) -> None:
+        self.block = block
+        self.done = False
+        self.losers: list[bytes] = []
+        self.exc: BaseException | None = None
+
+
 class ChunkIndex:
     """Thread-safe durable index with WAL + checkpoint recovery."""
 
-    def __init__(self, directory: str, checkpoint_every: int = 10000):
+    def __init__(self, directory: str, checkpoint_every: int = 10000,
+                 group_window_s: float = 0.0, group_max: int = 8):
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
@@ -77,6 +100,13 @@ class ChunkIndex:
         self._pending_recs: list[list] = []  # advisory recs awaiting a flush
         self._ops_since_ckpt = 0
         self._checkpoint_every = checkpoint_every
+        # group-commit window: 0 = every commit_block fsyncs on its own
+        # (the serial pipeline_depth=1 behavior)
+        self._group_window_s = group_window_s
+        self._group_max = max(group_max, 1)
+        self._gc_cv = threading.Condition()
+        self._gc_entries: list[_GCEntry] = []
+        self._gc_leader = False
         self._recover()
         self._wal = open(os.path.join(directory, WAL_NAME), "ab")
 
@@ -203,6 +233,8 @@ class ChunkIndex:
                              {h: [c, o, ln]
                               for h, (c, o, ln) in fresh.items()}])
             self._commit_many(recs)
+            _M.incr("group_commit_batches")
+            _M.observe("group_commit_blocks", len(recs))
             return losers
 
     def commit_block(self, block_id: int, logical_len: int, hashes: list[bytes],
@@ -216,7 +248,15 @@ class ChunkIndex:
         have appended its bytes and both declare it in ``new_chunks``.  The
         first commit wins; later commits keep the existing location and the
         loser's container bytes become orphans (reclaimed by compaction).
-        Returns the fingerprints that lost such races."""
+        Returns the fingerprints that lost such races.
+
+        With the group-commit window armed, concurrent callers park in the
+        window and share one fsync (leader/follower election); validation
+        failures stay PER CALLER — one bad block raises to its own writer
+        and the rest of the window commits."""
+        if self._group_window_s > 0:
+            return self._commit_block_grouped(
+                (block_id, logical_len, hashes, new_chunks))
         with profiler.phase("wal_commit"), self._lock:
             losers = [h for h in new_chunks if h in self._chunks]
             fresh = {h: loc for h, loc in new_chunks.items() if h not in self._chunks}
@@ -226,6 +266,98 @@ class ChunkIndex:
             self._commit([b"blk", block_id, logical_len, hashes,
                           {h: [c, o, ln] for h, (c, o, ln) in fresh.items()}])
             return losers
+
+    # --------------------------------------------------- group-commit window
+
+    def _commit_block_grouped(self, block: tuple) -> list[bytes]:
+        """Park ``block`` in the group-commit window; return once its record
+        is fsync'd + applied (or raise its per-caller validation error).
+        First arrival with no leader becomes the leader, waits out the
+        window (early-out at ``group_max``), and commits the whole batch
+        with one fsync; followers just wait on their entry."""
+        entry = _GCEntry(block)
+        with profiler.phase("wal_commit"):
+            with self._gc_cv:
+                self._gc_entries.append(entry)
+                profiler.counter_set("wal_queue_depth",
+                                     len(self._gc_entries))
+                self._gc_cv.notify_all()  # window-waiting leader may early-out
+                while not entry.done:
+                    if not self._gc_leader:
+                        self._gc_leader = True
+                        self._lead_group_locked()
+                    else:
+                        self._gc_cv.wait()
+        if entry.exc is not None:
+            raise entry.exc
+        return entry.losers
+
+    def _lead_group_locked(self) -> None:
+        """Leader body.  Called with ``_gc_cv`` held and ``_gc_leader`` set;
+        returns with both restored and every batch entry done-flagged."""
+        deadline = time.monotonic() + self._group_window_s
+        while len(self._gc_entries) < self._group_max:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            self._gc_cv.wait(timeout=remaining)
+        batch, self._gc_entries = self._gc_entries, []
+        profiler.counter_set("wal_queue_depth", 0)
+        # drop the cv while fsyncing so late arrivals queue the NEXT window
+        self._gc_cv.release()
+        try:
+            self._commit_group(batch)
+        finally:
+            self._gc_cv.acquire()
+            self._gc_leader = False
+            for e in batch:
+                e.done = True
+            self._gc_cv.notify_all()
+
+    def _commit_group(self, batch: list[_GCEntry]) -> None:
+        """Validate each entry (per-caller isolation: a bad block gets its
+        exception set and is EXCLUDED), then push the valid records through
+        one ``_commit_many`` — one WAL append, one fsync, apply after.  A
+        failed append leaves memory untouched and raises to every valid
+        caller (log-before-apply, now per window)."""
+        with self._lock:
+            recs: list[list] = []
+            committing: list[_GCEntry] = []
+            seen_new: set[bytes] = set()
+            for e in batch:
+                block_id, logical_len, hashes, new_chunks = e.block
+                fresh = {}
+                losers = []
+                try:
+                    for h, loc in new_chunks.items():
+                        if h in self._chunks or h in seen_new:
+                            losers.append(h)
+                        else:
+                            fresh[h] = loc
+                    for h in hashes:
+                        if h not in self._chunks and h not in fresh \
+                                and h not in seen_new:
+                            raise ValueError(
+                                f"hash {h.hex()} neither known nor new")
+                except ValueError as exc:
+                    e.exc = exc
+                    continue
+                seen_new.update(fresh)
+                e.losers = losers
+                recs.append([b"blk", block_id, logical_len, hashes,
+                             {h: [c, o, ln]
+                              for h, (c, o, ln) in fresh.items()}])
+                committing.append(e)
+            if not recs:
+                return
+            try:
+                self._commit_many(recs)
+            except BaseException as exc:  # each caller re-raises its own
+                for e in committing:
+                    e.exc = exc
+                return
+            _M.incr("group_commit_batches")
+            _M.observe("group_commit_blocks", len(recs))
 
     def delete_block(self, block_id: int) -> list[bytes]:
         """Drop a block's Table-1 row and decref its chunks.  Returns the

@@ -36,7 +36,7 @@ import numpy as np
 from hdrf_tpu.ops import dispatch
 from hdrf_tpu.reduction import accounting, scheme as scheme_mod
 from hdrf_tpu.reduction.scheme import ReductionContext, ReductionScheme
-from hdrf_tpu.utils import metrics, profiler, tracing
+from hdrf_tpu.utils import fault_injection, metrics, profiler, tracing
 
 _M = metrics.registry("dedup")
 
@@ -101,6 +101,9 @@ def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
         known = index.lookup_chunks(list(first_range))
     new_hashes = [h for h, loc in known.items() if loc is None]
     with profiler.phase("container_io"):
+        # ordering probe: tests park block K here and assert block K+1's
+        # device dispatch is already enqueued (pipeline overlap contract)
+        fault_injection.point("dedup.container_append", block_id=block_id)
         locs = _append_new(containers, data, first_range, new_hashes,
                            on_seal or index.seal_container)
     index.commit_block(block_id, len(data), hashes,
@@ -136,6 +139,11 @@ class CommitPipeline:
         self._containers = containers
         self._batch = batch
         self._on_seal = on_seal or index.seal_container
+        # Seal compression runs on the store's seal worker, not this commit
+        # thread: an unlucky 32 MiB rollover compress otherwise stalls every
+        # group-committed block queued behind it.
+        if hasattr(containers, "enable_async_seals"):
+            containers.enable_async_seals()
         self._q: queue.Queue = queue.Queue()
         self._thread = threading.Thread(target=self._run,
                                         name="dedup-commit", daemon=True)
@@ -151,6 +159,8 @@ class CommitPipeline:
     def close(self) -> None:
         self._q.put(None)
         self._thread.join()
+        if hasattr(self._containers, "drain_seals"):
+            self._containers.drain_seals()
 
     def _run(self) -> None:
         while True:

@@ -221,7 +221,13 @@ class DataNode:
         else:
             self.replicas = self.volumes
         self.containers = self.volumes.containers
-        self.index = ChunkIndex(os.path.join(config.data_dir, "index"))
+        # WAL group-commit window: armed only when the multi-block pipeline
+        # is on (depth > 1) — serial writes would just pay the window wait
+        self.index = ChunkIndex(
+            os.path.join(config.data_dir, "index"),
+            group_window_s=(red.group_commit_window_ms / 1000.0
+                            if red.pipeline_depth > 1 else 0.0),
+            group_max=red.pipeline_max_inflight)
         recon = None
         if red.device_recon and backend == "tpu" and self._worker is None:
             from hdrf_tpu.ops.reconstruct import DeviceReconstructor
@@ -231,6 +237,19 @@ class DataNode:
         self.reduction_ctx = ReductionContext(
             config=red, containers=self.containers, index=self.index,
             backend=backend, worker=self._worker, recon=recon)
+        # Multi-block write pipeline (server/write_pipeline.py): shared
+        # device batches + overlap scheduling when depth > 1; None keeps
+        # the one-block-at-a-time serial path exactly as before.
+        self.write_pipeline = None
+        if red.pipeline_depth > 1:
+            from hdrf_tpu.server.write_pipeline import WritePipeline
+
+            self.write_pipeline = WritePipeline(
+                red.cdc, backend, depth=red.pipeline_depth,
+                max_inflight=red.pipeline_max_inflight)
+            # seal compression off the commit critical path too: an
+            # unlucky rollover must not stall the blocks queued behind it
+            self.containers.enable_async_seals()
         # Admission control: bounded slots instead of ticket queues.
         self._write_sem = threading.Semaphore(red.max_concurrent_writes)
         self._read_sem = threading.Semaphore(red.max_concurrent_reads)
@@ -408,7 +427,11 @@ class DataNode:
         self._sever_connections()
         for t in self._threads:
             t.join(timeout=5)
+        if self.write_pipeline is not None:
+            self.write_pipeline.close()   # before flush: no new dispatches
         self.containers.flush_open(on_seal=self.index.seal_container)
+        if hasattr(self.containers, "close_async_seals"):
+            self.containers.close_async_seals()
         self.index.close()
         if self._worker_supervisor is not None:
             self._worker_supervisor.stop()

@@ -1,0 +1,211 @@
+"""Async multi-block write pipeline: shared device batches for the DN.
+
+The reference's receive path is one-block-at-a-time: DataXceiver threads
+buffer independently and each block's reduction runs alone (DDRunner,
+DataDeduplicator.java:108-217), so concurrent streams never share device
+work and the accelerator idles between per-block dispatches.  This module
+is the admission/coalescing stage the vectorized-chunking line needs to
+keep the device fed (SURVEY.md §2.1; PERF_NOTES.md round 10 measured the
+serial path at 0.0% overlap efficiency):
+
+- ``submit(block_id, data, timeline)`` hands a fully-buffered block to the
+  pipeline and returns a Future of ``(cuts, digests)``.  Admission is
+  bounded by ``pipeline_max_inflight`` (config.py ReductionConfig) — the
+  same bounded-slots discipline the DN's write_slot applies to buffering
+  (DataXceiver.java:349-380's gate, applied one stage later).
+- On the TPU backend a single coalescer thread drains queued blocks up to
+  ``pipeline_depth`` per round, groups equal lengths, and runs each group
+  through ONE ResidentReducer program (ops/resident.py:358 submit_many —
+  one prep dispatch, one candidate readback, one digest readback for the
+  whole group).  New groups are ENQUEUED before any older group's
+  readback is awaited, so device work for block K+1 is in flight while
+  block K's host commit (container append, WAL, mirror) runs — the only
+  real overlaps on the 1-vCPU DN host (PERF_NOTES.md round 4).
+- On the native backend (and at ``pipeline_depth`` 1) ``submit`` computes
+  inline on the calling connection thread via ops/dispatch.py:105
+  ``chunk_and_fingerprint`` — bit-identical results, today's serial
+  behavior, no extra thread hops.
+
+Each group's enqueue→finish window is recorded as a ``device_wait`` span
+into EVERY member block's timeline (utils/profiler.py BlockTimeline), so
+gap_report's per-block overlap accounting sees exactly what the shared
+batch hid.  The reducer instance is shared with ops/dispatch.py's
+``_resident_cache`` (same ``(cdc, fused-mode)`` key), keeping one jit
+cache per configuration process-wide.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from hdrf_tpu.ops import dispatch
+from hdrf_tpu.utils import metrics, profiler
+
+_M = metrics.registry("write_pipeline")
+
+
+class _Item:
+    __slots__ = ("block_id", "arr", "timeline", "future")
+
+    def __init__(self, block_id: int, arr: np.ndarray, timeline,
+                 future: Future) -> None:
+        self.block_id = block_id
+        self.arr = arr
+        self.timeline = timeline
+        self.future = future
+
+
+class WritePipeline:
+    """Admission + device-batch coalescing for concurrent block writes."""
+
+    def __init__(self, cdc, backend: str, depth: int = 4,
+                 max_inflight: int = 8):
+        self._cdc = cdc
+        self._backend = backend
+        self._depth = max(depth, 1)
+        self._sem = threading.BoundedSemaphore(max(max_inflight, 1))
+        self._q: queue.Queue = queue.Queue()
+        self._thread: threading.Thread | None = None
+        if backend == "tpu" and self._depth > 1:
+            self._thread = threading.Thread(target=self._coalesce_loop,
+                                            name="write-pipeline",
+                                            daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------ admission
+
+    def submit(self, block_id: int, data, timeline=None) -> Future:
+        """Reduce ``data`` (host bytes / u8 array); Future resolves to
+        ``(cuts, digests)``.  Blocks at the ``pipeline_max_inflight``
+        admission bound (backpressure on client streams)."""
+        arr = (data if isinstance(data, np.ndarray)
+               else np.frombuffer(data, dtype=np.uint8))
+        if not self._sem.acquire(timeout=300):
+            raise TimeoutError("write pipeline admission timeout")
+        fut: Future = Future()
+        fut.add_done_callback(lambda _f: self._sem.release())
+        if self._thread is None:
+            # Serial/native path: compute on the caller's thread — the
+            # native choke point records its own reduce_compute phase.
+            _M.incr("inline_reduces")
+            try:
+                fut.set_result(dispatch.chunk_and_fingerprint(
+                    arr, self._cdc, self._backend))
+            except BaseException as e:  # noqa: BLE001 — caller unwraps
+                fut.set_exception(e)
+            return fut
+        self._q.put(_Item(block_id, arr, timeline, fut))
+        return fut
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join()
+            self._thread = None
+
+    # ------------------------------------------------------------ coalescer
+
+    def _reducer(self):
+        """The dispatch-cache ResidentReducer for this cdc config (shared
+        jit cache with the per-block chunk_and_fingerprint path)."""
+        from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode
+        from hdrf_tpu.ops.resident import ResidentReducer
+
+        key = (self._cdc.mask_bits, self._cdc.min_chunk,
+               self._cdc.max_chunk, cdc_pallas_mode())
+        r = dispatch._resident_cache.get(key)
+        if r is None:
+            r = dispatch._resident_cache[key] = ResidentReducer(
+                self._cdc, fused_mode=key[3])
+        return r
+
+    def _drain(self, block: bool) -> tuple[list[_Item], bool]:
+        """Up to ``depth`` queued items; ``block`` waits for the first."""
+        items: list[_Item] = []
+        try:
+            first = self._q.get(block=block)
+        except queue.Empty:
+            return items, False
+        if first is None:
+            return items, True
+        items.append(first)
+        while len(items) < self._depth:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is None:
+                return items, True
+            items.append(nxt)
+        return items, False
+
+    def _coalesce_loop(self) -> None:
+        r = self._reducer()
+        # (BatchJob, members): submitted (enqueued) but not yet finished
+        inflight: deque = deque()
+        stopping = False
+        while True:
+            if not stopping:
+                items, stopping = self._drain(block=not inflight)
+                for group in self._group(r, items):
+                    try:
+                        # ENQUEUE the group's device program now — before
+                        # any older group's readback below is awaited.
+                        bj = r.submit_many([it.arr for it in group])
+                    except BaseException as e:  # noqa: BLE001
+                        for it in group:
+                            if not it.future.done():
+                                it.future.set_exception(e)
+                        continue
+                    _M.incr("device_batches")
+                    _M.observe("device_batch_blocks", len(group))
+                    inflight.append((bj, group))
+            if not inflight:
+                if stopping:
+                    return
+                continue
+            # Finish the OLDEST group only, then loop back to admit newer
+            # arrivals: their dispatches enqueue under this group's commit.
+            bj, group = inflight.popleft()
+            lead = group[0].timeline
+            n0 = len(lead.ledger_ids) if lead is not None else 0
+            t0 = profiler.mark()
+            try:
+                # the lead member's timeline is ambient for the readbacks,
+                # so the device ledger's hook gives it real device_wait
+                # spans + event-id links; they're mirrored to the rest below
+                with profiler.bind_timeline(lead):
+                    r.start_sha_many(bj)
+                    results = r.finish_many(bj)
+            except BaseException as e:  # noqa: BLE001
+                for it in group:
+                    if not it.future.done():
+                        it.future.set_exception(e)
+                continue
+            t1 = profiler.mark()
+            new_ids = lead.ledger_ids[n0:] if lead is not None else []
+            for idx, (it, res) in enumerate(zip(group, results)):
+                tl = it.timeline
+                if tl is not None and idx > 0:
+                    # shared wait window + ledger links for every member —
+                    # the per-block overlap accountant's device_wait input
+                    tl.add_span("device_wait", t0, t1, 0)
+                    tl.ledger_ids.extend(new_ids)
+                it.future.set_result(res)
+
+    def _group(self, r, items: list[_Item]) -> list[list[_Item]]:
+        """Equal-length groups bounded by the reducer's max_group."""
+        by_len: dict[int, list[_Item]] = {}
+        for it in items:
+            by_len.setdefault(it.arr.size, []).append(it)
+        groups: list[list[_Item]] = []
+        for size, members in by_len.items():
+            g = max(1, min(self._depth, r.max_group(size)))
+            for at in range(0, len(members), g):
+                groups.append(members[at:at + g])
+        return groups

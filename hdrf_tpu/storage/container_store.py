@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import queue
 import struct
 import threading
 from dataclasses import dataclass
@@ -99,6 +100,11 @@ class ContainerStore:
         self._cache: dict[int, bytes] = {}
         self._cache_cap = cache_containers
         self._cache_lock = threading.Lock()
+        # Async seal stage (enable_async_seals): rollover compression moves
+        # off the appending thread onto one worker; None = inline seals.
+        self._seal_q: queue.Queue | None = None
+        self._seal_thread: threading.Thread | None = None
+        self._seal_exc: BaseException | None = None
 
     def _scan_next_id(self) -> int:
         mx = -1
@@ -259,10 +265,21 @@ class ContainerStore:
         payload = bytes(lane.image)
         if self._on_roll is not None:
             self._on_roll(lane.container_id, payload)
-        self.seal(lane.container_id, data=payload, have_raw=had_raw,
-                  comp=comp)
-        if on_seal is not None:
-            on_seal(lane.container_id)
+        if self._seal_q is not None:
+            # Async stage: hand the payload to the seal worker and return —
+            # the appending (commit) thread never pays the compressor.  Safe
+            # because sealed-ness is self-describing: the raw file stays
+            # readable (read_container's raw fallback) until the worker's
+            # seal renames it, and the cid is retired from the lane HERE, so
+            # no later append can touch it.
+            self._seal_q.put((lane.container_id, payload, had_raw, on_seal,
+                              comp))
+            _M.incr("async_seals")
+        else:
+            self.seal(lane.container_id, data=payload, have_raw=had_raw,
+                      comp=comp)
+            if on_seal is not None:
+                on_seal(lane.container_id)
         lane.fh = None
         lane.image = None
 
@@ -357,6 +374,59 @@ class ContainerStore:
                 _M.incr("batch_seals", len(sealable))
             for lane, comp in zip(sealable, comps or [None] * len(sealable)):
                 self._seal_locked(lane, on_seal, comp=comp)
+        self.drain_seals()
+
+    # --------------------------------------------------------- async sealing
+
+    def enable_async_seals(self) -> None:
+        """Move rollover compression off the appending thread onto a single
+        seal worker (the write pipeline's commit stage must not stall on an
+        unlucky 32 MiB compress).  Idempotent.  Durability is unchanged:
+        the raw file persists (and serves reads) until the worker's sealed
+        file is in place, exactly the ordering ``seal`` already guarantees
+        for concurrent readers."""
+        if self._seal_q is not None:
+            return
+        self._seal_q = queue.Queue()
+        self._seal_thread = threading.Thread(
+            target=self._seal_worker, name="container-seal", daemon=True)
+        self._seal_thread.start()
+
+    def _seal_worker(self) -> None:
+        while True:
+            item = self._seal_q.get()
+            if item is None:
+                self._seal_q.task_done()
+                return
+            cid, payload, had_raw, on_seal, comp = item
+            try:
+                self.seal(cid, data=payload, have_raw=had_raw, comp=comp)
+                if on_seal is not None:
+                    on_seal(cid)
+            except BaseException as e:  # noqa: BLE001 — re-raised at drain
+                self._seal_exc = e
+            finally:
+                self._seal_q.task_done()
+
+    def drain_seals(self) -> None:
+        """Barrier: every enqueued async seal is on disk (or its error is
+        raised here).  No-op with async seals disabled."""
+        if self._seal_q is None:
+            return
+        self._seal_q.join()
+        if self._seal_exc is not None:
+            exc, self._seal_exc = self._seal_exc, None
+            raise exc
+
+    def close_async_seals(self) -> None:
+        """Drain, then stop the seal worker (shutdown hook)."""
+        if self._seal_q is None:
+            return
+        self.drain_seals()
+        self._seal_q.put(None)
+        self._seal_thread.join()
+        self._seal_q = None
+        self._seal_thread = None
 
     # -------------------------------------------------------------- reading
 

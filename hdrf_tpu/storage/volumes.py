@@ -487,6 +487,18 @@ class MultiContainerStore:
         for v in self._vs._alive():
             v.containers.flush_open(on_seal=on_seal)
 
+    def enable_async_seals(self) -> None:
+        for v in self._vs._alive():
+            v.containers.enable_async_seals()
+
+    def drain_seals(self) -> None:
+        for v in self._vs._alive():
+            v.containers.drain_seals()
+
+    def close_async_seals(self) -> None:
+        for v in self._vs._alive():
+            v.containers.close_async_seals()
+
     def physical_bytes(self) -> int:
         return sum(v.containers.physical_bytes() for v in self._vs._alive())
 

@@ -13,9 +13,10 @@ hideable wait; the 1-vCPU DN host's only lever, PERF_NOTES.md round 4).
 
 Sources, in order of preference:
 
-- ``--input FILE``: a JSON list of BlockTimeline snapshots (the
-  ``timelines`` field of bench.py's phase_profile dump or a /traces-style
-  capture);
+- ``--input FILE``: a JSON list of BlockTimeline snapshots (a
+  /traces-style capture), OR bench.py's single JSON output line itself
+  (the ``phase_profile`` object is lifted out), OR a bare window/phase
+  profile object — so ``python bench.py > out.json`` pipes straight in;
 - default: run an in-process MiniCluster smoke write (the tiny-corpus
   analog of ``HDRF_BENCH_SMOKE``) and report over its timelines — the
   zero-setup mode the acceptance gate drives
@@ -136,7 +137,14 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     if args.input:
         with open(args.input) as f:
-            timelines = json.load(f)
+            doc = json.load(f)
+        if isinstance(doc, dict):
+            # bench.py's JSON line (lift its phase_profile) or a bare
+            # window-profile object: view it as one pseudo-timeline so
+            # the same aggregation serves both shapes
+            prof = doc.get("phase_profile", doc)
+            doc = [{"nbytes": prof.get("bytes", 0), "profile": prof}]
+        timelines = doc
     else:
         timelines = run_smoke(n_blocks=args.blocks)
     agg = aggregate(timelines)

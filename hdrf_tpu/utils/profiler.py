@@ -60,6 +60,7 @@ PHASE_CLASS = {
     "recv": TRANSPORT, "mirror_stream": TRANSPORT, "ack": TRANSPORT,
     "dedup_lookup": HOST, "wal_commit": HOST, "container_io": HOST,
     "reduce_compute": HOST, "checksum": HOST, "buffer_assemble": HOST,
+    "pipeline_submit": HOST,
     "device_wait": DEVICE,
 }
 
@@ -67,8 +68,8 @@ PHASE_CLASS = {
 # overlap inside one elementary interval (rare: host phases are serial on
 # this host) — first match wins.
 PHASE_ORDER = ("device_wait", "wal_commit", "container_io", "dedup_lookup",
-               "reduce_compute", "checksum", "buffer_assemble", "recv",
-               "mirror_stream", "ack")
+               "reduce_compute", "checksum", "buffer_assemble",
+               "pipeline_submit", "recv", "mirror_stream", "ack")
 
 
 def phase_class(name: str) -> str:
@@ -250,6 +251,24 @@ def block_timeline(block_id: int, nbytes: int = 0) -> Iterator[BlockTimeline]:
 
 def current_timeline() -> BlockTimeline | None:
     return _current.get()
+
+
+@contextlib.contextmanager
+def bind_timeline(tl: BlockTimeline | None) -> Iterator[BlockTimeline | None]:
+    """Adopt an EXISTING timeline as this thread's ambient one.
+
+    Contextvars do not propagate into worker threads, so the write
+    pipeline's helper threads (the ack/checksum pump, the device-batch
+    coalescer — server/write_pipeline.py) would otherwise record their
+    spans ring-only and the per-block overlap accountant would never see
+    the work they hid.  Binding does NOT finish the timeline or touch the
+    inflight counter — ownership stays with the opening
+    :func:`block_timeline` frame."""
+    tok = _current.set(tl)
+    try:
+        yield tl
+    finally:
+        _current.reset(tok)
 
 
 def _observe_finished(tl: BlockTimeline) -> None:

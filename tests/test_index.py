@@ -183,6 +183,127 @@ def test_record_moves_and_live_bytes(tmp_path):
     idx2.close()
 
 
+def _grouped_pair(idx, blk_a, blk_b, timeout=30.0):
+    """Drive two commit_block callers into ONE group-commit window,
+    deterministically: start A, wait until it is the parked leader, then
+    start B (the leader early-outs at group_max=2).  Returns
+    {block_id: result-or-exception}."""
+    import threading
+    import time as _t
+
+    out = {}
+
+    def commit(blk):
+        bid = blk[0]
+        try:
+            out[bid] = idx.commit_block(*blk)
+        except BaseException as e:  # noqa: BLE001 — asserted by callers
+            out[bid] = e
+
+    ta = threading.Thread(target=commit, args=(blk_a,))
+    ta.start()
+    deadline = _t.monotonic() + timeout
+    while not (idx._gc_leader and len(idx._gc_entries) == 1):
+        assert _t.monotonic() < deadline, "leader never parked in window"
+        _t.sleep(0.001)
+    tb = threading.Thread(target=commit, args=(blk_b,))
+    tb.start()
+    ta.join(timeout)
+    tb.join(timeout)
+    assert not ta.is_alive() and not tb.is_alive()
+    return out
+
+
+class TestGroupCommit:
+    def test_window_shares_one_fsync(self, tmp_path, monkeypatch):
+        # Two concurrent committers inside one window: the whole batch goes
+        # through ONE WAL append + ONE fsync (FSEditLog.logSync batching).
+        idx = ChunkIndex(str(tmp_path), group_window_s=10.0, group_max=2)
+        calls = []
+        real = os.fsync
+        monkeypatch.setattr(os, "fsync",
+                            lambda fd: (calls.append(fd), real(fd))[1])
+        out = _grouped_pair(idx,
+                            (1, 10, [h(1)], {h(1): (0, 0, 10)}),
+                            (2, 10, [h(2)], {h(2): (0, 10, 10)}))
+        assert out == {1: [], 2: []}
+        assert len(calls) == 1, f"expected one shared fsync, got {len(calls)}"
+        assert idx.has_block(1) and idx.has_block(2)
+        idx.close()
+
+    def test_group_append_failure_leaves_memory_untouched(self, tmp_path):
+        # Log-before-apply holds per window: a failed WAL append raises to
+        # EVERY caller of the batch and no block becomes visible.
+        from hdrf_tpu.utils import fault_injection
+
+        class Crash(Exception):
+            pass
+
+        idx = ChunkIndex(str(tmp_path), group_window_s=10.0, group_max=2)
+        with fault_injection.inject(
+                "index.wal_append",
+                lambda **kw: (_ for _ in ()).throw(Crash())):
+            out = _grouped_pair(idx,
+                                (1, 10, [h(1)], {h(1): (0, 0, 10)}),
+                                (2, 10, [h(2)], {h(2): (0, 10, 10)}))
+        assert isinstance(out[1], Crash) and isinstance(out[2], Crash)
+        assert not idx.has_block(1) and not idx.has_block(2)
+        # the log holds nothing the memory doesn't: a later commit works
+        # and recovery sees exactly it
+        out = _grouped_pair(idx,
+                            (3, 10, [h(3)], {h(3): (0, 20, 10)}),
+                            (4, 10, [h(4)], {h(4): (0, 30, 10)}))
+        assert out == {3: [], 4: []}
+        idx.close()
+        idx2 = ChunkIndex(str(tmp_path))
+        assert not idx2.has_block(1) and not idx2.has_block(2)
+        assert idx2.has_block(3) and idx2.has_block(4)
+        idx2.close()
+
+    def test_crash_mid_window_loses_only_unacked_blocks(self, tmp_path):
+        # A crash DURING the window's single WAL append (torn tail, the PR-5
+        # discipline) drops only the torn record's block; the batch prefix
+        # replays — nobody whose record tore was ever acked.
+        idx = ChunkIndex(str(tmp_path), group_window_s=10.0, group_max=2)
+        out = _grouped_pair(idx,
+                            (1, 10, [h(1)], {h(1): (0, 0, 10)}),
+                            (2, 10, [h(2)], {h(2): (0, 10, 10)}))
+        assert out == {1: [], 2: []}
+        idx.close()
+        wal = tmp_path / "index.wal"
+        wal.write_bytes(wal.read_bytes()[:-3])  # tear the batch's last record
+        idx2 = ChunkIndex(str(tmp_path))
+        assert idx2.has_block(1)        # durable prefix of the window
+        assert not idx2.has_block(2)    # torn (unacked) block only
+        idx2.close()
+
+    def test_validation_errors_stay_per_caller(self, tmp_path):
+        # One bad block in the window (undeclared hash) raises to ITS caller
+        # only; the valid block still commits in the same window.
+        idx = ChunkIndex(str(tmp_path), group_window_s=10.0, group_max=2)
+        out = _grouped_pair(idx,
+                            (1, 10, [h(1)], {h(1): (0, 0, 10)}),
+                            (2, 10, [h(9)], {}))  # h(9) neither known nor new
+        assert out[1] == []
+        assert isinstance(out[2], ValueError)
+        assert idx.has_block(1) and not idx.has_block(2)
+        idx.close()
+
+    def test_intra_window_dedup_first_entry_wins(self, tmp_path):
+        # Both windowed blocks declare the SAME never-seen chunk new: the
+        # first entry registers it, the second is told it lost the race
+        # (same contract as the serial cross-commit race).
+        idx = ChunkIndex(str(tmp_path), group_window_s=10.0, group_max=2)
+        out = _grouped_pair(idx,
+                            (1, 10, [h(1)], {h(1): (0, 0, 10)}),
+                            (2, 10, [h(1)], {h(1): (3, 50, 10)}))
+        assert out[1] == [] and out[2] == [h(1)]
+        loc = idx.chunk_location(h(1))
+        assert (loc.container_id, loc.offset) == (0, 0)
+        assert loc.refcount == 2
+        idx.close()
+
+
 def test_stats(tmp_path):
     idx = ChunkIndex(str(tmp_path))
     idx.commit_block(1, 100, [h(1), h(1)], {h(1): (0, 0, 50)})

@@ -472,3 +472,9 @@ class TestBenchContract:
         # must have been attributed some exclusive time
         assert pp["phases"].get("reduce_compute", 0) > 0
         assert pp["phases"].get("wal_commit", 0) > 0
+        # pipeline-depth stamp (same shape in the no-TPU and TPU prints):
+        # configured depth, WAL group-commit batches, overlap efficiency
+        pl = doc["pipeline"]
+        assert int(pl["depth"]) >= 1
+        assert int(pl["group_commit_batches"]) >= 0
+        assert 0.0 <= float(pl["overlap_efficiency"]) <= 1.0
