@@ -35,10 +35,13 @@ Prints ONE JSON line:
    "tg_value": <MB/s>, "tg_vs_baseline": <x>,
    "tg_ratio_tpu": <r>, "tg_ratio_cpu": <r>,   # TeraGen-row corpus
    "phase_profile": {"wall_s", "classes", "phases",
-                     "overlap_efficiency", "attributed_frac"}}
+                     "overlap_efficiency", "attributed_frac"},
                                                # write-path critical-path
                                                # profiler window over the
                                                # e2e passes (utils/profiler)
+   "ec": {"stripes_encoded", "degraded_reads", "repair_bytes",
+          "storage_ratio"}}                    # EC cold-tier stamp
+                                               # (storage/stripe_store.py)
 """
 
 from __future__ import annotations
@@ -271,6 +274,35 @@ def _resilience_summary() -> dict:
     }
 
 
+def _ec_summary() -> dict:
+    """EC cold-tier stamp for the JSON line: a small in-process
+    demote-shaped exercise through storage/stripe_store.py — encode one
+    container at RS(6,3), drop m stripes INCLUDING data indices (the
+    worst degraded case), reconstruct, assert bit-identity — then the
+    process-wide ``ec`` registry counters (this exercise plus any product
+    EC activity in the run).  ``storage_ratio`` is the tier's
+    physical/logical expansion, (k+m)*stripe_len / length ≈ 1.5."""
+    from hdrf_tpu.storage import stripe_store
+    from hdrf_tpu.utils import metrics
+
+    k, m = 6, 3
+    rng = np.random.default_rng(11)
+    payload = rng.integers(0, 256, size=(1 << 20) + 3,
+                           dtype=np.uint8).tobytes()
+    stripes, manifest = stripe_store.encode_container(payload, k, m)
+    survivors = {i: stripes[i] for i in range(m, k + m)}
+    assert stripe_store.reconstruct_container(survivors, manifest) \
+        == payload, "EC degraded read diverged from the encoded container"
+    ec = metrics.registry("ec")
+    return {
+        "stripes_encoded": ec.counter("stripes_encoded"),
+        "degraded_reads": ec.counter("degraded_reads"),
+        "repair_bytes": ec.counter("repair_bytes"),
+        "storage_ratio": round(
+            (k + m) * manifest["stripe_len"] / manifest["length"], 4),
+    }
+
+
 def _phase_profile(t0: float, t1: float) -> dict:
     """Cross-thread overlap profile of [t0, t1] for the JSON line: wall
     partitioned into the profiler's exclusive classes (host/device busy,
@@ -352,6 +384,7 @@ def main() -> None:
                 "cdc_fused": _cdc_fused_summary(),
                 "stalls": led.get("stall_total", 0),
                 "resilience": _resilience_summary(),
+                "ec": _ec_summary(),
                 "phase_profile": phase_profile,
                 "pipeline": _pipeline_summary(phase_profile),
             }))
@@ -677,6 +710,7 @@ def main() -> None:
             "cdc_fused": _cdc_fused_summary(),
             "stalls": led.get("stall_total", 0),
             "resilience": _resilience_summary(),
+            "ec": _ec_summary(),
             "phase_profile": phase_profile,
             "pipeline": _pipeline_summary(phase_profile),
         }))

@@ -187,40 +187,69 @@ def bench_dfs(args) -> None:
 
 
 def bench_ec(args) -> None:
-    from hdrf_tpu.ops import rs
-    from hdrf_tpu.testing.minicluster import MiniCluster
+    """EC cold-tier harness: paired encode / intact-reassembly /
+    degraded-decode slopes over the container striping path
+    (storage/stripe_store.py on top of ops/rs.py), slope method — one
+    timed call vs ``--inner`` back-to-back calls, (t_k - t_1)/(k-1)
+    dividing out the fixed dispatch constant (PERF_NOTES.md round 4's
+    discipline).  The pair that matters is intact vs degraded: intact
+    reassembly is pure CRC+concat (all k data stripes present), degraded
+    drops the first m stripes (all-data erasures, the worst case) and
+    decodes through parity on the device — their ratio is the cold
+    tier's read penalty.  Parity is pinned against the GF log/antilog
+    oracle (rs.encode_ref) before timing.  Prints exactly ONE JSON
+    line."""
+    import jax
 
-    k, m, cell = rs.parse_policy(args.policy)
+    from hdrf_tpu.ops import rs
+    from hdrf_tpu.storage import stripe_store
+
+    k, m, _cell = rs.parse_policy(args.policy)
     rng = np.random.default_rng(7)
-    L = (args.mb << 20) // k
-    data = rng.integers(0, 256, size=(k, L), dtype=np.uint8)
-    parity = rs.rs_encode(data, k, m)  # warm/compile
-    t0 = time.perf_counter()
-    parity = rs.rs_encode(data, k, m)
-    enc = k * L / (time.perf_counter() - t0) / 2**20
-    shards = {i: data[i] for i in range(k)} | {k + i: parity[i]
-                                              for i in range(m)}
-    for i in range(m):
-        del shards[i]
-    t0 = time.perf_counter()
-    rs.rs_decode(shards, k, m)
-    dec = k * L / (time.perf_counter() - t0) / 2**20
-    print(json.dumps({"op": f"rs_encode {args.policy}",
-                      "MBps": round(enc, 1)}))
-    print(json.dumps({"op": f"rs_decode {m} erasures",
-                      "MBps": round(dec, 1)}))
-    payload = data.tobytes()
-    with MiniCluster(n_datanodes=k + m, block_size=4 << 20) as mc:
-        with mc.client("ecbench") as c:
-            t0 = time.perf_counter()
-            c.write("/bench/ec", payload, ec=args.policy)
-            w = len(payload) / (time.perf_counter() - t0) / 2**20
-            t0 = time.perf_counter()
-            assert c.read("/bench/ec") == payload
-            r = len(payload) / (time.perf_counter() - t0) / 2**20
-    print(json.dumps({"op": f"striped write {args.policy}",
-                      "MBps": round(w, 1)}))
-    print(json.dumps({"op": "striped read", "MBps": round(r, 1)}))
+    n = args.mb << 20
+    payload = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+    stripes, manifest = stripe_store.encode_container(payload, k, m)
+
+    # pin vs the numpy GF oracle before trusting any timing
+    padded = np.zeros(k * manifest["stripe_len"], dtype=np.uint8)
+    padded[:n] = np.frombuffer(payload, dtype=np.uint8)
+    ref = rs.encode_ref(padded.reshape(k, -1), m)
+    oracle_ok = all(bytes(ref[i]) == stripes[k + i] for i in range(m))
+
+    intact = {i: stripes[i] for i in range(k)}
+    degraded = {i: stripes[i] for i in range(m, k + m)}
+
+    def slope_mbps(fn) -> float:
+        fn()  # warm: jit compile + page in
+        t0 = time.perf_counter()
+        fn()
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(args.inner):
+            fn()
+        tk = time.perf_counter() - t0
+        per = ((tk - t1) / (args.inner - 1)) if args.inner > 1 else t1
+        return n / max(per, 1e-9) / 2**20
+
+    enc = slope_mbps(lambda: stripe_store.encode_container(payload, k, m))
+    rd_ok = slope_mbps(
+        lambda: stripe_store.reconstruct_container(intact, manifest))
+    rd_deg = slope_mbps(
+        lambda: stripe_store.reconstruct_container(degraded, manifest))
+    print(json.dumps({
+        "op": f"ec cold tier [{args.policy}, slope]",
+        "mb": args.mb, "backend": jax.default_backend(),
+        "k": k, "m": m, "inner": args.inner,
+        "parity_oracle_ok": bool(oracle_ok),
+        "encode_MBps": round(enc, 1),
+        "intact_read_MBps": round(rd_ok, 1),
+        "degraded_read_MBps": round(rd_deg, 1),
+        "degraded_penalty": (round(rd_ok / rd_deg, 3)
+                             if rd_deg > 0 else None),
+        # the tier's expansion: (k+m)*stripe_len over true length
+        "storage_ratio": round(
+            (k + m) * manifest["stripe_len"] / manifest["length"], 4),
+    }))
 
 
 def bench_reduction(args) -> None:
@@ -583,6 +612,8 @@ def main(argv: list[str] | None = None) -> int:
     d = sub.add_parser("ec")
     d.add_argument("--mb", type=int, default=48)
     d.add_argument("--policy", default="rs-6-3-64k")
+    d.add_argument("--inner", type=int, default=4,
+                   help="k for the slope method's long pass")
     d.set_defaults(fn=bench_ec)
     d = sub.add_parser("reduction")
     d.add_argument("--mb", type=int, default=64)

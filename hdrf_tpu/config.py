@@ -187,6 +187,15 @@ class NameNodeConfig:
     status_port: int | None = None
     # Watchdog budget for in-flight RPCs (utils/watchdog.py).
     stall_budget_s: float = 30.0
+    # EC cold tier (storage/stripe_store.py): sealed-container striping
+    # geometry (ErasureCodingPolicy RS-k-m analog, default RS(6,3)) and
+    # the demotion age: a complete, fully-replicated block whose file has
+    # been idle this long is demoted from ``replication``x full copies to
+    # (k+m)/k x stripes.  <= 0 disables demotion (default: the cold tier
+    # is opt-in, like dfs.namenode.ec.system.default.policy being unset).
+    ec_data_shards: int = 6
+    ec_parity_shards: int = 3
+    ec_demote_after_s: float = 0.0
 
 
 @dataclass

@@ -53,6 +53,25 @@ CKPT_NAME = "index.ckpt"
 CKPT_TMP = "index.ckpt.tmp"
 
 
+def _norm_manifest(m: dict) -> dict:
+    """Normalize a striping manifest read back through msgpack raw=True:
+    dict keys and string values arrive as bytes — decode them so live-path
+    and recovered manifests compare equal (the replay idempotence bar the
+    WAL discipline sets)."""
+    out = {}
+    for key, v in m.items():
+        key = key.decode() if isinstance(key, bytes) else key
+        if isinstance(v, bytes):
+            v = v.decode()
+        elif isinstance(v, (list, tuple)):
+            v = [[x.decode() if isinstance(x, bytes) else x for x in e]
+                 if isinstance(e, (list, tuple))
+                 else (e.decode() if isinstance(e, bytes) else e)
+                 for e in v]
+        out[key] = v
+    return out
+
+
 @dataclass
 class ChunkLocation:
     """Where a chunk's bytes live.  Fixed-width equivalent of the reference's
@@ -96,6 +115,7 @@ class ChunkIndex:
         self._blocks: dict[int, BlockEntry] = {}
         self._chunks: dict[bytes, ChunkLocation] = {}
         self._sealed: set[int] = set()  # container ids sealed (compressed)
+        self._stripes: dict[int, dict] = {}  # cid -> EC striping manifest
         self._seq = 0  # last seqno applied
         self._pending_recs: list[list] = []  # advisory recs awaiting a flush
         self._ops_since_ckpt = 0
@@ -124,6 +144,8 @@ class ChunkIndex:
                 h: ChunkLocation(*loc) for h, loc in snap[b"chunks"].items()
             }
             self._sealed = set(snap[b"sealed"])
+            self._stripes = {cid: _norm_manifest(m)
+                             for cid, m in snap.get(b"stripes", {}).items()}
             self._seq = snap.get(b"seq", 0)
         # recover() truncates any torn tail so the append handle continues at
         # the good prefix (otherwise post-crash records land behind garbage).
@@ -160,6 +182,10 @@ class ChunkIndex:
                     c.container_id, c.offset, c.length = loc[0], loc[1], loc[2]
         elif op == b"unseal":  # [op, container_id] — container deleted by GC
             self._sealed.discard(rec[1])
+        elif op == b"stripe":  # [op, container_id, manifest] — EC demotion
+            self._stripes[rec[1]] = _norm_manifest(rec[2])
+        elif op == b"unstripe":  # [op, container_id] — promoted back / deleted
+            self._stripes.pop(rec[1], None)
 
     # ------------------------------------------------------------------ WAL
 
@@ -390,6 +416,31 @@ class ChunkIndex:
             self._pending_recs.append([b"seal", container_id])
             self._apply([b"seal", container_id])
 
+    def record_stripe(self, container_id: int, manifest: dict) -> None:
+        """Durably record an EC striping manifest for a sealed container
+        (the cold-tier demotion commit point: after this fsync the sealed
+        file may be deleted — the manifest + any k stripes reproduce it).
+        One WAL record, immediate fsync: unlike seal markers this is NOT
+        advisory — losing it orphans remote stripes."""
+        with self._lock:
+            self._commit([b"stripe", container_id, dict(manifest)])
+
+    def drop_stripe(self, container_id: int) -> None:
+        """Forget a container's striping manifest (container deleted, or
+        re-replicated back to the hot tier)."""
+        with self._lock:
+            if container_id in self._stripes:
+                self._commit([b"unstripe", container_id])
+
+    def stripe_manifest(self, container_id: int) -> dict | None:
+        with self._lock:
+            m = self._stripes.get(container_id)
+            return dict(m) if m is not None else None
+
+    def stripe_manifests(self) -> dict[int, dict]:
+        with self._lock:
+            return {cid: dict(m) for cid, m in self._stripes.items()}
+
     def record_moves(self, moves: dict[bytes, tuple[int, int, int]],
                      dropped_container: int | None = None) -> None:
         """Commit a compaction: chunks relocated to new container positions.
@@ -445,6 +496,7 @@ class ChunkIndex:
                 "blocks": len(self._blocks),
                 "chunks": len(self._chunks),
                 "sealed_containers": len(self._sealed),
+                "striped_containers": len(self._stripes),
                 "logical_bytes": sum(b.logical_len for b in self._blocks.values()),
                 "unique_chunk_bytes": sum(c.length for c in self._chunks.values()),
             }
@@ -481,6 +533,7 @@ class ChunkIndex:
             "chunks": {h: [c.container_id, c.offset, c.length, c.refcount]
                        for h, c in self._chunks.items()},
             "sealed": sorted(self._sealed),
+            "stripes": {cid: m for cid, m in self._stripes.items()},
             "seq": self._seq,
         }
         tmp = os.path.join(self._dir, CKPT_TMP)

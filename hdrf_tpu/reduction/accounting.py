@@ -61,6 +61,22 @@ def record_worker_bytes(op: str, nbytes: int) -> None:
     _ACC.incr(f"worker_{op}_bytes", int(nbytes))
 
 
+def record_stripe_tier(logical_bytes: int, physical_bytes: int) -> None:
+    """EC cold-tier byte accounting (server/ec_tier.py's heartbeat stamp):
+    logical = sealed-container bytes demoted to stripes, physical = stripe
+    bytes on this DN's disk.  Gauges, not counters — the tier's CURRENT
+    footprint, refreshed per heartbeat, so the cluster physical/logical ratio
+    stays repr-exact as containers demote and repair."""
+    _ACC.gauge("stripe_tier_logical_bytes", int(logical_bytes))
+    _ACC.gauge("stripe_tier_physical_bytes", int(physical_bytes))
+
+
+def stripe_ratio(logical_bytes: int, physical_bytes: int) -> float:
+    """Stripe-tier physical/logical expansion: ~(k+m)/k (1.5 for RS(6,3))
+    vs the replicated tier's replication factor; 0.0 for an empty tier."""
+    return (physical_bytes / logical_bytes) if logical_bytes else 0.0
+
+
 def snapshot() -> dict:
     """The registry snapshot (rides DN heartbeats; also on /prom and
     /metrics through the process-wide exposition)."""

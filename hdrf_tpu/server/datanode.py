@@ -237,6 +237,13 @@ class DataNode:
         self.reduction_ctx = ReductionContext(
             config=red, containers=self.containers, index=self.index,
             backend=backend, worker=self._worker, recon=recon)
+        # EC cold tier (server/ec_tier.py): stripe store + demote/serve/
+        # repair roles; installs the degraded-read fallback hooks on the
+        # container stores (AFTER the recon _on_delete wiring above — the
+        # tier chains, not replaces, that observer).
+        from hdrf_tpu.server.ec_tier import EcTier
+
+        self.ec = EcTier(self)
         # Multi-block write pipeline (server/write_pipeline.py): shared
         # device batches + overlap scheduling when depth > 1; None keeps
         # the one-block-at-a-time serial path exactly as before.
@@ -678,6 +685,13 @@ class DataNode:
                 fields["block_id"], fields["length"],
                 new_gs=fields.get("new_gen_stamp"))
             send_frame(sock, {"ok": ok})
+        elif op == "stripe_read":
+            # EC cold tier: hand one local stripe to a gathering peer
+            # (DN-protocol trust, like disk_balance — stripe ops never
+            # carry client bytes, only already-stored container stripes)
+            self.ec.serve_read(sock, fields)
+        elif op == "stripe_write":
+            self.ec.serve_write(sock, fields)
         else:
             _M.incr("unknown_ops")
 
@@ -887,10 +901,12 @@ class DataNode:
             "blocks": len(self.replicas.block_ids()),
             "logical_bytes": sum(m[2] for m in self.replicas.block_report()),
             "physical_bytes": (self.replicas.physical_bytes()
-                               + self.containers.physical_bytes()),
+                               + self.containers.physical_bytes()
+                               + self.ec.store.physical_bytes()),
             "cached_blocks": self.cache.ids(),
             "cache_used": self.cache.used(),
             "index": self.index.stats(),
+            "ec": self.ec.report(),
         }
 
     def _execute(self, cmd: dict) -> None:
@@ -908,6 +924,10 @@ class DataNode:
             self._replicate(cmd)
         elif cmd["cmd"] == "ec_reconstruct":
             self._ec_reconstruct(cmd)
+        elif cmd["cmd"] == "stripe_demote":
+            self.ec.demote(cmd)
+        elif cmd["cmd"] == "stripe_repair":
+            self.ec.repair(cmd)
         elif cmd["cmd"] == "recover_block":
             self._recover_block(cmd)
         elif cmd["cmd"] == "cache":

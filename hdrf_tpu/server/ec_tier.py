@@ -1,0 +1,328 @@
+"""DataNode half of the EC(6,3) cold tier: demotion, serving, repair.
+
+Re-expresses the reference's DN-side erasure-coding worker stack —
+ErasureCodingWorker.java:55 (reconstruction executor wired to NN
+commands), StripedBlockReconstructor.java:41 (fan-in k shards, decode,
+write back), StripedBlockReader.java:40 (per-shard fetch legs),
+BlockECReconstructionCommand (DNA_ERASURE_CODING_RECONSTRUCTION) — on
+top of the container abstraction: the striping unit is a **sealed
+container file** (storage/stripe_store.py), not a raw block group, so
+demotion multiplies the EC saving with the reduction ratio.  Three
+roles live here:
+
+- **Demote** (NN ``stripe_demote`` command): RS-encode every sealed
+  container backing a cold block, push the k+m stripes to NN-chosen
+  holders (peer ``stripe_write`` ops under the retry/deadline spine,
+  utils/retry.py), WAL the manifest in the chunk index
+  (index/chunk_index.py record_stripe — the commit point), then delete
+  the local sealed file and report ``stripe_complete`` to the NN.
+- **Degraded read** (ContainerStore ``_stripe_fallback`` hook): when a
+  chunk gather misses the sealed file, gather any k surviving stripes —
+  local disk first, then peers, skipping breaker-open edges (PR-5
+  evidence) — and reassemble the exact sealed bytes, decoding through
+  ops/rs.py only when a data stripe is lost.  The reconstructed payload
+  feeds the unchanged decompress + device chunk-gather path
+  (ops/reconstruct.py), so reads stay bit-identical to the replicated
+  tier.
+- **Repair** (NN ``stripe_repair`` command): re-decode exactly the lost
+  stripe indices from k survivors and push them to replacement holders,
+  keeping the manifest's holder map current.
+"""
+
+from __future__ import annotations
+
+import os
+
+from hdrf_tpu.storage import stripe_store
+from hdrf_tpu.storage.container_store import _SEAL_HDR, _SEAL_MAGIC
+from hdrf_tpu.utils import fault_injection, metrics, retry
+
+_M = metrics.registry("ec")
+
+# budget for one whole demote/repair command (all stripe legs); each peer
+# leg retries under it via the ambient-deadline discipline of utils/retry
+_CMD_BUDGET_S = 60.0
+
+
+class EcTier:
+    """Owns the DN's stripe store + the three cold-tier roles above."""
+
+    def __init__(self, dn) -> None:
+        self._dn = dn
+        self.store = stripe_store.StripeStore(
+            os.path.join(dn.config.data_dir, "stripes"))
+        # degraded-read hooks: a missing sealed file falls through to
+        # reconstruction; has_container consults the manifest's payload size
+        dn.containers._stripe_fallback = self.reconstruct_sealed
+        dn.containers._stripe_probe = self.stripe_usize
+        # chain the delete observer: a deleted striped container must drop
+        # its local stripes + manifest too (remote stripes are reclaimed by
+        # the NN's repair loop noticing the group vanished)
+        prev_on_delete = dn.containers._on_delete
+
+        def _on_delete(cid: int) -> None:
+            if prev_on_delete is not None:
+                prev_on_delete(cid)
+            if dn.index.stripe_manifest(cid) is not None:
+                self.store.delete_stripes(dn.dn_id, cid)
+                dn.index.drop_stripe(cid)
+
+        dn.containers._on_delete = _on_delete
+
+    # ------------------------------------------------------------ hooks
+
+    def stripe_usize(self, cid: int) -> int | None:
+        """Uncompressed payload size of a striped container (has_container's
+        extent check), or None when the container is not striped."""
+        m = self._dn.index.stripe_manifest(cid)
+        return int(m["usize"]) if m is not None else None
+
+    def reconstruct_sealed(self, cid: int) -> bytes | None:
+        """ContainerStore fallback: reassemble the sealed FILE bytes of a
+        demoted container from any k surviving stripes.  None = not striped
+        or unrecoverable (the store then raises its original error)."""
+        manifest = self._dn.index.stripe_manifest(cid)
+        if manifest is None:
+            return None
+        _M.incr("stripe_gathers")
+        got = self._gather(cid, manifest)
+        k = int(manifest["k"])
+        if len(got) < k:
+            _M.incr("degraded_read_failures")
+            return None
+        # (a gather missing a data stripe decodes through parity — the
+        # store's reconstruct_container counts that as a degraded read)
+        try:
+            blob = stripe_store.reconstruct_container(got, manifest)
+        except (stripe_store.StripeCorrupt, ValueError):
+            _M.incr("degraded_read_failures")
+            return None
+        assert isinstance(blob, bytes)
+        return blob
+
+    # ---------------------------------------------------------- serving
+
+    def serve_read(self, sock, fields: dict) -> None:
+        """Peer ``stripe_read``: hand one local stripe to a gatherer."""
+        from hdrf_tpu.proto.rpc import send_frame
+
+        fault_injection.point("stripe.read", dn_id=self._dn.dn_id)
+        owner = fields["owner"]
+        cid, idx = int(fields["cid"]), int(fields["idx"])
+        try:
+            data = self.store.read_stripe(owner, cid, idx)
+        except FileNotFoundError:
+            send_frame(sock, {"ok": False,
+                              "error": f"no stripe {owner}/{cid}/{idx}"})
+            return
+        send_frame(sock, {"ok": True, "data": data})
+
+    def serve_write(self, sock, fields: dict) -> None:
+        """Peer ``stripe_write``: durably store a stripe pushed by the
+        demoting/repairing owner (CRC-checked before the ack)."""
+        from hdrf_tpu.proto.rpc import send_frame
+
+        try:
+            self.store.put_stripe(fields["owner"], int(fields["cid"]),
+                                  int(fields["idx"]), fields["data"],
+                                  crc=fields.get("crc"))
+        except stripe_store.StripeCorrupt as e:
+            send_frame(sock, {"ok": False, "error": str(e)})
+            return
+        send_frame(sock, {"ok": True})
+
+    # --------------------------------------------------------- demotion
+
+    def demote(self, cmd: dict) -> None:
+        """NN ``stripe_demote``: stripe every sealed, not-yet-striped
+        container backing ``block_id`` onto ``targets``, then report.
+        Ordering per container: stripes durable on holders -> manifest
+        WAL'd -> sealed file deleted — a crash at any point leaves the
+        container readable (sealed file until the WAL commit, stripes
+        after)."""
+        dn = self._dn
+        bid = cmd["block_id"]
+        k, m = int(cmd["k"]), int(cmd["m"])
+        targets = [list(t) for t in cmd["targets"]]
+        if len(targets) != k + m:
+            _M.incr("demote_failures")
+            return
+        entry = dn.index.get_block(bid)
+        if entry is None:
+            return
+        cids: list[int] = []
+        for h in entry.hashes:
+            loc = dn.index.chunk_location(h)
+            if loc is not None and loc.container_id not in cids:
+                cids.append(loc.container_id)
+        done: list[dict] = []
+        with retry.bind(retry.Deadline(_CMD_BUDGET_S)):
+            for cid in cids:
+                if dn.index.stripe_manifest(cid) is not None:
+                    continue  # already striped (shared container)
+                blob = dn.containers.sealed_file_bytes(cid)
+                if blob is None:
+                    continue  # open/raw container: stays hot
+                magic, usize, _codec = _SEAL_HDR.unpack(
+                    blob[:_SEAL_HDR.size])
+                if magic != _SEAL_MAGIC:
+                    continue
+                stripes, manifest = stripe_store.encode_container(blob, k, m)
+                manifest.update(owner=dn.dn_id, usize=usize,
+                                holders=targets)
+                try:
+                    for idx, data in enumerate(stripes):
+                        self._place(targets[idx], cid, idx, data,
+                                    manifest["crcs"][idx])
+                except (OSError, ConnectionError, IOError,
+                        retry.DeadlineExceeded):
+                    _M.incr("demote_failures")
+                    continue  # no manifest committed: sealed file stays
+                dn.index.record_stripe(cid, manifest)
+                freed = dn.containers.drop_sealed_file(cid)
+                _M.incr("containers_demoted")
+                _M.incr("demote_bytes_freed", freed)
+                done.append({"cid": cid, "holders": targets,
+                             "logical": manifest["length"],
+                             "physical": (k + m) * manifest["stripe_len"]})
+        if done:
+            self._notify_nn(bid, done)
+
+    def repair(self, cmd: dict) -> None:
+        """NN ``stripe_repair``: re-decode the lost stripe indices from k
+        survivors and push them to replacement holders."""
+        dn = self._dn
+        fault_injection.point("stripe.repair", dn_id=dn.dn_id)
+        cid = int(cmd["cid"])
+        manifest = dn.index.stripe_manifest(cid)
+        if manifest is None:
+            return
+        missing = [int(i) for i in cmd["missing"]]
+        targets = [list(t) for t in cmd["targets"]]
+        with retry.bind(retry.Deadline(_CMD_BUDGET_S)):
+            got = self._gather(cid, manifest, exclude=set(missing))
+            try:
+                decoded = stripe_store.reconstruct_container(
+                    got, manifest, want=missing)
+            except (stripe_store.StripeCorrupt, ValueError):
+                _M.incr("repair_failures")
+                return
+            holders = [list(t) for t in manifest["holders"]]
+            try:
+                for idx, tgt in zip(missing, targets):
+                    self._place(tgt, cid, idx, decoded[idx],
+                                manifest["crcs"][idx])
+                    holders[idx] = list(tgt)
+                    _M.incr("repair_bytes", len(decoded[idx]))
+            except (OSError, ConnectionError, IOError,
+                    retry.DeadlineExceeded):
+                _M.incr("repair_failures")
+                return
+        manifest["holders"] = holders
+        dn.index.record_stripe(cid, manifest)
+        _M.incr("stripes_repaired", len(missing))
+        self._notify_nn(cmd.get("block_id"),
+                        [{"cid": cid, "holders": holders,
+                          "logical": manifest["length"],
+                          "physical": (int(manifest["k"])
+                                       + int(manifest["m"]))
+                          * manifest["stripe_len"]}])
+
+    # ---------------------------------------------------------- plumbing
+
+    def _place(self, target: list, cid: int, idx: int, data: bytes,
+               crc: int) -> None:
+        """Durably land one stripe on ``target`` (local fast path; peers
+        via stripe_write with capped retries under the ambient deadline and
+        the background-transfer throttle)."""
+        dn = self._dn
+        tgt_id, host, port = target[0], target[1], int(target[2])
+        if tgt_id == dn.dn_id:
+            self.store.put_stripe(dn.dn_id, cid, idx, data, crc=crc)
+            return
+        dn.balance_throttler.throttle(len(data))
+
+        def _push() -> None:
+            resp = dn._peer_call((host, port), "stripe_write",
+                                 owner=dn.dn_id, cid=cid, idx=idx,
+                                 data=data, crc=crc)
+            if not resp.get("ok"):
+                raise IOError(f"stripe_write {cid}/{idx} to {tgt_id}: "
+                              f"{resp.get('error')}")
+        retry.call_with_retries(
+            _push, attempts=3,
+            retry_on=(ConnectionError, OSError, IOError))
+
+    def _gather(self, cid: int, manifest: dict,
+                exclude: set[int] | None = None) -> dict[int, bytes]:
+        """Fetch up to k stripes, data indices first (no decode needed when
+        all k arrive), skipping ``exclude`` and breaker-open peers."""
+        dn = self._dn
+        k, m = int(manifest["k"]), int(manifest["m"])
+        owner = manifest.get("owner", dn.dn_id)
+        holders = manifest["holders"]
+        got: dict[int, bytes] = {}
+        for idx in range(k + m):
+            if len(got) >= k:
+                break
+            if exclude and idx in exclude:
+                continue
+            tgt_id, host, port = (holders[idx][0], holders[idx][1],
+                                  int(holders[idx][2]))
+            if tgt_id == dn.dn_id:
+                try:
+                    got[idx] = self.store.read_stripe(owner, cid, idx)
+                except OSError:
+                    continue
+                continue
+            br = retry.breaker(f"{dn.dn_id}->{tgt_id}")
+            if not br.allow():
+                _M.incr("breaker_skips")
+                continue
+            try:
+                resp = dn._peer_call((host, port), "stripe_read",
+                                     owner=owner, cid=cid, idx=idx)
+                if not resp.get("ok"):
+                    raise IOError(resp.get("error", "stripe_read failed"))
+                got[idx] = resp["data"]
+                br.record_success()
+            except (OSError, ConnectionError, IOError, KeyError):
+                br.record_failure()
+                continue
+        return got
+
+    def _notify_nn(self, block_id, containers: list[dict]) -> None:
+        """Report new/updated stripe groups (and the demoted block) to the
+        NameNodes; first accepting NN wins — the active applies it, a
+        standby refuses (same pattern as commit_block_sync)."""
+        from hdrf_tpu.proto.rpc import RpcError
+
+        for nn in self._dn._nns:
+            try:
+                nn.call("stripe_complete", dn_id=self._dn.dn_id,
+                        block_id=block_id, containers=containers)
+                return
+            except (OSError, ConnectionError, RpcError):
+                continue
+        _M.incr("stripe_complete_failures")
+
+    # ------------------------------------------------------------- stats
+
+    def report(self) -> dict:
+        """Heartbeat payload: tier sizes + the holder map the NN's repair
+        scheduler rebuilds its soft state from (stripe groups are WAL-
+        durable HERE, in the owner DN's chunk index — the NN only caches)."""
+        from hdrf_tpu.reduction import accounting
+
+        manifests = self._dn.index.stripe_manifests()
+        logical = sum(int(m["length"]) for m in manifests.values())
+        physical = self.store.physical_bytes()
+        accounting.record_stripe_tier(logical, physical)
+        return {
+            "striped_containers": len(manifests),
+            "stripe_logical_bytes": logical,
+            "stripe_physical_bytes": physical,
+            "manifests": {str(cid): {"holders": m["holders"],
+                                     "length": int(m["length"])}
+                          for cid, m in manifests.items()},
+        }

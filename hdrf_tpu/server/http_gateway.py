@@ -435,7 +435,14 @@ class HttpGateway:
                 "dead": sum(1 for d in report if not d["alive"]),
                 "dedup_ratio": cluster.get("dedup_ratio"),
                 "slow_peers": cluster.get("slow_peers"),
-                "slow_volumes": cluster.get("slow_volumes")}
+                "slow_volumes": cluster.get("slow_volumes"),
+                # EC cold tier: striped census + stripe-tier footprint
+                "ec_demoted_blocks": cluster.get("ec_demoted_blocks", 0),
+                "striped_containers": cluster.get("striped_containers", 0),
+                "stripe_logical_bytes":
+                    cluster.get("stripe_logical_bytes", 0),
+                "stripe_physical_bytes":
+                    cluster.get("stripe_physical_bytes", 0)}
 
     def health(self) -> dict:
         """Cluster health verdict for load balancers / dashboards: DN
@@ -471,7 +478,15 @@ class HttpGateway:
                 "mirror_failures": slow.get("mirror_failures") or {},
                 "dedup_ratio": cluster["dedup_ratio"],
                 "dedup_logical_bytes": cluster["dedup_logical_bytes"],
-                "dedup_unique_bytes": cluster["dedup_unique_bytes"]}
+                "dedup_unique_bytes": cluster["dedup_unique_bytes"],
+                # EC cold tier (physical/logical ≈ (k+m)/k for striped
+                # containers vs the replicated tier's factor)
+                "ec_demoted_blocks": cluster.get("ec_demoted_blocks", 0),
+                "striped_containers": cluster.get("striped_containers", 0),
+                "stripe_logical_bytes":
+                    cluster.get("stripe_logical_bytes", 0),
+                "stripe_physical_bytes":
+                    cluster.get("stripe_physical_bytes", 0)}
 
     def metrics(self) -> dict:
         with HdrfClient(self._nn_addr, name="http-gw") as c:

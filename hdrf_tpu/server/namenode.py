@@ -297,6 +297,15 @@ class NameNode:
         self._pending_space: dict[str, int] = {}   # quota root -> charged bytes
         self._pending_recovery: dict[int, float] = {}  # bid -> retry deadline
         self._recovery_grace: dict[int, float] = {}    # bid -> IBR-wait deadline
+        # EC cold tier: blocks demoted to (k+m)/k stripes (editlog-durable
+        # "ec_demote" records; demoted blocks want ONE full replica, the
+        # stripe owner).  Stripe groups are SOFT state — the WAL-durable
+        # copy lives in each owner DN's chunk index; this cache is rebuilt
+        # from stripe_complete RPCs + heartbeat manifest reports.
+        self._ec_demoted: set[int] = set()
+        self._stripe_groups: dict[tuple[str, int], dict] = {}
+        self._pending_demote: dict[int, float] = {}       # bid -> deadline
+        self._pending_stripe_repair: dict[tuple[str, int], float] = {}
         # Snapshots: frozen subtree images per snapshottable dir
         # (namenode/snapshot analog; blocks are immutable once complete, so a
         # structural freeze IS a consistent point-in-time view).
@@ -492,6 +501,7 @@ class NameNode:
                            for i, d in self._cache_dirs.items()},
             "next_cache_id": self._next_cache_id,
             "dtokens": self._dtokens.snapshot(),
+            "ec_demoted": sorted(self._ec_demoted),
         }
 
     def _restore(self, snap: dict) -> None:
@@ -539,6 +549,7 @@ class NameNode:
         self._next_cache_id = snap.get("next_cache_id", 1)
         if "dtokens" in snap:
             self._dtokens.restore(snap["dtokens"])
+        self._ec_demoted = set(snap.get("ec_demoted", []))
 
     def _apply(self, rec: list) -> None:
         """Apply one edit record (replay path and live path share this)."""
@@ -754,6 +765,11 @@ class NameNode:
                 self._quotas[path] = (ns_q if ns_q >= 0 else old[0],
                                       sp_q if sp_q >= 0 else old[1])
                 self._qusage[path] = None  # seed lazily
+        elif op == "ec_demote":
+            # [op, block_id] — block's containers demoted to the EC stripe
+            # tier; from here the block wants ONE full replica (the stripe
+            # owner) and redundancy lives in the (k+m)/k stripes.
+            self._ec_demoted.add(rec[1])
 
     def _account(self, rec: list) -> None:
         """Keep cached quota usage in sync with an applied edit.  Cheap ops
@@ -2454,6 +2470,8 @@ class NameNode:
             dn.stats = stats or {}
             if "cached_blocks" in dn.stats:
                 dn.cached = set(dn.stats["cached_blocks"])
+            if "ec" in dn.stats:
+                self._refresh_stripe_groups(dn_id, dn.stats["ec"])
             # refresh health intelligence on every stats delivery so the
             # slow-peer/slow-volume gauges are never older than one
             # heartbeat interval (SlowPeerTracker's report-driven update)
@@ -2701,6 +2719,7 @@ class NameNode:
             live = dead = decom = 0
             logical = physical = cached = 0
             ded_logical = ded_unique = 0
+            ec_striped = ec_logical = ec_physical = 0
             for d in self._datanodes.values():
                 alive = (now - d.last_heartbeat
                          < self.config.dead_node_interval_s)
@@ -2717,6 +2736,10 @@ class NameNode:
                 idx = st.get("index") or {}
                 ded_logical += int(idx.get("logical_bytes", 0))
                 ded_unique += int(idx.get("unique_chunk_bytes", 0))
+                ec = st.get("ec") or {}
+                ec_striped += int(ec.get("striped_containers", 0))
+                ec_logical += int(ec.get("stripe_logical_bytes", 0))
+                ec_physical += int(ec.get("stripe_physical_bytes", 0))
             # The under-replicated count is the redundancy monitor's own
             # (cached each _check_replication tick) — recomputing it here
             # would both duplicate the want/counted semantics and walk
@@ -2740,6 +2763,13 @@ class NameNode:
                 "dedup_logical_bytes": ded_logical,
                 "dedup_unique_bytes": ded_unique,
                 "dedup_ratio": _acc.dedup_ratio(ded_logical, ded_unique),
+                # EC cold tier: demoted census + stripe-tier footprint
+                # (the dfshealth page's "storage ratio" row pairs this
+                # against the replicated tier's factor)
+                "ec_demoted_blocks": len(self._ec_demoted),
+                "striped_containers": ec_striped,
+                "stripe_logical_bytes": ec_logical,
+                "stripe_physical_bytes": ec_physical,
                 "slow_peers": len(health["slow_peers"]),
                 "slow_volumes": len(health["slow_volumes"]),
                 "reduction_degraded": len(health["degraded_nodes"]),
@@ -2747,6 +2777,103 @@ class NameNode:
                 "editlog_seq": self._editlog.seq,
                 "journal_addrs": [list(a) for a in
                                   (self.config.journal_addrs or [])],
+            }
+
+    def _refresh_stripe_groups(self, dn_id: str, ec: dict) -> None:
+        """Rebuild this owner's slice of the soft-state stripe-group cache
+        from its heartbeat manifest report (the WAL-durable copy is the
+        owner DN's chunk index; an NN restart or failover re-learns every
+        group within one heartbeat).  Caller holds self._lock."""
+        reported = {}
+        for cid_s, g in (ec.get("manifests") or {}).items():
+            reported[int(cid_s)] = {
+                "holders": [list(h) for h in g["holders"]],
+                "length": int(g.get("length", 0))}
+        for cid, grp in reported.items():
+            cur = self._stripe_groups.get((dn_id, cid))
+            grp["block_id"] = cur.get("block_id") if cur else None
+            self._stripe_groups[(dn_id, cid)] = grp
+        for key in [kk for kk in self._stripe_groups
+                    if kk[0] == dn_id and kk[1] not in reported]:
+            # owner dropped the manifest (container deleted/promoted)
+            del self._stripe_groups[key]
+            self._pending_stripe_repair.pop(key, None)
+
+    def rpc_stripe_complete(self, dn_id: str, block_id=None,
+                            containers: list | None = None) -> bool:
+        """Owner-DN report closing a stripe demotion (or refreshing holder
+        maps after a repair): journal the block's demotion (``ec_demote``
+        edit — from here the redundancy monitor wants ONE full replica),
+        invalidate the other full replicas, and cache the stripe groups
+        for the repair scheduler.  First accepting NN wins — a standby
+        refuses, the same contract as commit_block_sync."""
+        with self._lock:
+            if self.role != "active":
+                raise StandbyError("namenode is standby")
+            for c in containers or []:
+                key = (dn_id, int(c["cid"]))
+                self._stripe_groups[key] = {
+                    "holders": [list(h) for h in c["holders"]],
+                    "length": int(c.get("logical", 0)),
+                    "block_id": block_id}
+                self._pending_stripe_repair.pop(key, None)
+            if block_id is None:
+                return True  # repair of an unmapped group: cache only
+            bid = int(block_id)
+            self._pending_demote.pop(bid, None)
+            info = self._blocks.get(bid)
+            if info is None:
+                return True
+            if bid not in self._ec_demoted:
+                self._log(["ec_demote", bid])
+                _M.incr("blocks_ec_demoted")
+            # the owner is now the single full-replica holder; the other
+            # copies are excess (redundancy rides the stripes)
+            for d in sorted(info.locations - {dn_id}):
+                other = self._datanodes.get(d)
+                if other is not None:
+                    other.commands.append({"cmd": "invalidate",
+                                           "block_ids": [bid]})
+                    other.blocks.discard(bid)
+                info.reported.pop(d, None)
+                info.storage_of.pop(d, None)
+            info.locations &= {dn_id}
+            self._pending_repl.pop(bid, None)
+            return True
+
+    def rpc_ec_status(self) -> dict:
+        """Cold-tier census backing ``dfsadmin -ecStatus`` and the
+        gateway's /status and /health EC rows: striped vs replicated
+        container counts, the tier's physical/logical expansion (~(k+m)/k)
+        against the replicated tier's factor, and the schedulers' queue
+        depths — aggregated from the DNs' heartbeat ``ec`` stats."""
+        from hdrf_tpu.reduction import accounting as _acc
+
+        with self._lock:
+            striped = sealed = 0
+            logical = physical = 0
+            for d in self._datanodes.values():
+                ec = (d.stats or {}).get("ec") or {}
+                striped += int(ec.get("striped_containers", 0))
+                logical += int(ec.get("stripe_logical_bytes", 0))
+                physical += int(ec.get("stripe_physical_bytes", 0))
+                idx = (d.stats or {}).get("index") or {}
+                sealed += int(idx.get("sealed_containers", 0))
+            return {
+                "policy": (f"rs-{self.config.ec_data_shards}"
+                           f"-{self.config.ec_parity_shards}"),
+                "demote_after_s": self.config.ec_demote_after_s,
+                "demoted_blocks": len(self._ec_demoted),
+                "pending_demotions": len(self._pending_demote),
+                "pending_stripe_repairs": len(self._pending_stripe_repair),
+                "stripe_groups": len(self._stripe_groups),
+                "striped_containers": striped,
+                "replicated_containers": max(0, sealed - striped),
+                "stripe_logical_bytes": logical,
+                "stripe_physical_bytes": physical,
+                "storage_ratio_striped": _acc.stripe_ratio(logical,
+                                                           physical),
+                "storage_ratio_replicated": float(self.config.replication),
             }
 
     def rpc_finalize_upgrade(self) -> dict:
@@ -3427,6 +3554,8 @@ class NameNode:
                 self._settle_moves()
                 self._check_cache()
                 self._recover_leases()
+                self._check_ec_demotion()
+                self._check_stripe_repair()
                 with self._lock:
                     self._dtokens.purge_expired()
                 if self._editlog.should_checkpoint():
@@ -3466,7 +3595,11 @@ class NameNode:
                 # EC internal blocks: zero-location loss is handled by
                 # _check_ec_groups (reconstruction); a draining host still
                 # holds live bytes, so the drain is a plain 1-replica copy.
-                want = 1 if info.block_id in ec_bids else node.replication
+                # stripe-demoted blocks keep ONE full replica (the stripe
+                # owner); redundancy lives in the (k+m)/k cold-tier stripes
+                want = (1 if info.block_id in ec_bids
+                        or info.block_id in self._ec_demoted
+                        else node.replication)
                 live = {d for d in info.locations if d in self._datanodes}
                 # PROVIDED replicas are views of ONE shared external store:
                 # N DataNodes mounting the same provided volume add no
@@ -3590,6 +3723,122 @@ class NameNode:
                 self._pending_repl[bid] = (
                     now + self.config.pending_replication_timeout_s)
                 _M.incr("ec_reconstructions_scheduled")
+
+    def _ec_placement_pool(self, now: float) -> list["DatanodeInfo"]:
+        """Stripe-target pool: live, non-decommissioning DNs minus the
+        health report's veto set — slow peers, reduction-degraded nodes,
+        and any DN with a flagged slow volume (the PR-3 detectors gating
+        cold-tier placement).  Caller holds self._lock."""
+        health = self._health_report()
+        vetoed = set(health["slow_peers"]) | set(health["degraded_nodes"])
+        vetoed |= {v.split(":", 1)[0] for v in health["slow_volumes"]}
+        pool = [d for d in self._datanodes.values()
+                if now - d.last_heartbeat < self.config.dead_node_interval_s
+                and d.dn_id not in self._decommissioning
+                and d.dn_id not in vetoed]
+        pool.sort(key=lambda d: d.dn_id)
+        return pool
+
+    def _check_ec_demotion(self) -> None:
+        """EC cold-tier demotion scheduler: blocks of complete files idle
+        past ``ec_demote_after_s`` drop from ``replication``x full copies
+        to (k+m)/k stripes.  The primary holder is commanded to stripe its
+        sealed containers (server/ec_tier.py demote); the demotion only
+        becomes durable when that DN reports ``stripe_complete`` back —
+        until then the block stays fully replicated.  Stripe i lands on
+        pool[i % len(pool)], so a cluster smaller than k+m still places
+        every stripe (with spread returning as the cluster grows)."""
+        cfg = self.config
+        if cfg.ec_demote_after_s <= 0:
+            return
+        with self._lock:
+            now = time.monotonic()
+            cutoff = time.time() - cfg.ec_demote_after_s
+            k, m = cfg.ec_data_shards, cfg.ec_parity_shards
+            self._pending_demote = {
+                b: t for b, t in self._pending_demote.items()
+                if t > now or b in self._blocks}
+            pool = self._ec_placement_pool(now)
+            if not pool:
+                return
+            ec_bids = {b for g in self._groups.values() for b in g.bids}
+            for info in list(self._blocks.values()):
+                bid = info.block_id
+                if bid in self._ec_demoted or bid in ec_bids:
+                    continue
+                if self._pending_demote.get(bid, 0.0) > now:
+                    continue
+                node = self._try_file(info.path)
+                if (node is None or not node.complete or node.ec
+                        or info.length < 0):
+                    continue
+                if node.mtime <= 0 or node.mtime > cutoff:
+                    continue
+                live = sorted(d for d in info.locations
+                              if d in self._datanodes)
+                # demote only from full health: a replica deficit means
+                # redundancy is already degraded — re-replicate first
+                if len(live) < node.replication:
+                    continue
+                owner = self._datanodes[live[0]]
+                targets = [pool[i % len(pool)] for i in range(k + m)]
+                owner.commands.append({
+                    "cmd": "stripe_demote", "block_id": bid,
+                    "k": k, "m": m,
+                    "targets": [[t.dn_id, t.addr[0], t.addr[1]]
+                                for t in targets]})
+                self._pending_demote[bid] = (
+                    now + cfg.pending_replication_timeout_s)
+                _M.incr("ec_demotions_scheduled")
+
+    def _check_stripe_repair(self) -> None:
+        """Background stripe-repair scheduler over the soft-state group
+        cache: a stripe whose holder left the cluster is re-decoded by the
+        group's owner DN (it holds the WAL-durable manifest) onto healthy
+        replacements.  Owner loss itself is not repairable here — the
+        manifest lives in the owner's chunk index, so the owner IS the
+        group (documented trade-off, ARCHITECTURE.md decision 9)."""
+        with self._lock:
+            now = time.monotonic()
+            dead_after = self.config.dead_node_interval_s
+            for (owner_id, cid), grp in list(self._stripe_groups.items()):
+                owner = self._datanodes.get(owner_id)
+                if (owner is None
+                        or now - owner.last_heartbeat >= dead_after):
+                    continue  # repair agency lives with the owner
+                missing = []
+                for idx, h in enumerate(grp["holders"]):
+                    d = self._datanodes.get(h[0])
+                    if d is None or now - d.last_heartbeat >= dead_after:
+                        missing.append(idx)
+                key = (owner_id, cid)
+                if not missing:
+                    self._pending_stripe_repair.pop(key, None)
+                    continue
+                if self._pending_stripe_repair.get(key, 0.0) > now:
+                    continue
+                survivors = {h[0] for i, h in enumerate(grp["holders"])
+                             if i not in missing}
+                base = self._ec_placement_pool(now)
+                # small clusters: if every healthy DN already holds a
+                # surviving stripe, double up on survivors (distinct
+                # (owner,cid,idx) filenames make that safe) rather than
+                # leaving the group degraded forever
+                pool = ([d for d in base if d.dn_id not in survivors]
+                        or base)
+                if not pool:
+                    continue
+                targets = [pool[i % len(pool)]
+                           for i in range(len(missing))]
+                owner.commands.append({
+                    "cmd": "stripe_repair", "cid": cid,
+                    "block_id": grp.get("block_id"),
+                    "missing": missing,
+                    "targets": [[t.dn_id, t.addr[0], t.addr[1]]
+                                for t in targets]})
+                self._pending_stripe_repair[key] = (
+                    now + self.config.pending_replication_timeout_s)
+                _M.incr("stripe_repairs_scheduled")
 
     def _recover_leases(self) -> None:
         with self._lock:

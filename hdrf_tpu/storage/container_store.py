@@ -85,6 +85,14 @@ class ContainerStore:
         # observer for container deletion (compaction/GC): lets a device
         # reconstructor drop its stale HBM image
         self._on_delete = None
+        # EC cold tier hooks (storage/stripe_store.py): when a sealed file
+        # is gone because the container was demoted to stripes,
+        # ``_stripe_fallback(cid)`` returns the reconstructed sealed FILE
+        # bytes (header + compressed payload) or None, and
+        # ``_stripe_probe(cid)`` returns the uncompressed payload size
+        # recorded in the striping manifest (for has_container) or None.
+        self._stripe_fallback = None
+        self._stripe_probe = None
         self._alloc_lock = threading.Lock()
         # ``id_base`` namespaces this store's container ids (multi-volume
         # DNs: vol_id << CID_SHIFT — the same trick the reference uses to
@@ -453,11 +461,23 @@ class ContainerStore:
                 return f.read()
         except FileNotFoundError:
             pass
-        with open(self._sealed_path(cid), "rb") as f:
-            magic, usize, codec_id = _SEAL_HDR.unpack(f.read(_SEAL_HDR.size))
-            if magic != _SEAL_MAGIC:
-                raise IOError(f"container {cid}: bad magic {magic:#x}")
-            data = codecs.decompress(codecs.CODEC_NAMES[codec_id], f.read(), usize)
+        try:
+            with open(self._sealed_path(cid), "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            # Demoted to the EC cold tier: the sealed file was replaced by
+            # k+m stripes.  The DN-installed fallback reassembles the exact
+            # sealed-file bytes from any k survivors (degraded read path).
+            if self._stripe_fallback is None:
+                raise
+            blob = self._stripe_fallback(cid)
+            if blob is None:
+                raise
+        magic, usize, codec_id = _SEAL_HDR.unpack(blob[:_SEAL_HDR.size])
+        if magic != _SEAL_MAGIC:
+            raise IOError(f"container {cid}: bad magic {magic:#x}")
+        data = codecs.decompress(codecs.CODEC_NAMES[codec_id],
+                                 blob[_SEAL_HDR.size:], usize)
         with self._cache_lock:
             self._cache[cid] = data
             while len(self._cache) > self._cache_cap:
@@ -498,6 +518,31 @@ class ContainerStore:
         new_locs = self.append_chunks(chunks, on_seal=on_seal)
         return dict(zip(hashes, new_locs))
 
+    def sealed_file_bytes(self, cid: int) -> bytes | None:
+        """Raw sealed FILE bytes (header + compressed payload) — the EC
+        cold tier's striping unit (stripe_store.py encodes exactly these
+        bytes, so reassembly needs no re-compression).  None when the
+        container is open or already striped."""
+        try:
+            with open(self._sealed_path(cid), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def drop_sealed_file(self, cid: int) -> int:
+        """Unlink just the sealed file (EC demotion: the stripes + manifest
+        now carry the bytes).  Unlike delete_container this keeps the LRU
+        entry (the decompressed payload is still valid) and does NOT fire
+        ``_on_delete`` — the container remains logically present.  Returns
+        bytes freed."""
+        path = self._sealed_path(cid)
+        try:
+            size = os.path.getsize(path)
+            os.unlink(path)
+            return size
+        except OSError:
+            return 0
+
     def delete_container(self, cid: int) -> None:
         for p in (self._raw_path(cid), self._sealed_path(cid)):
             if os.path.exists(p):
@@ -531,7 +576,12 @@ class ContainerStore:
                 magic, usize, _codec = _SEAL_HDR.unpack(hdr)
                 return magic == _SEAL_MAGIC and usize >= need_bytes
         except OSError:
-            return False
+            pass
+        if self._stripe_probe is not None:
+            usize = self._stripe_probe(cid)
+            if usize is not None:  # striped: manifest records payload size
+                return usize >= need_bytes
+        return False
 
     def container_ids(self) -> list[int]:
         ids = set()

@@ -470,6 +470,12 @@ class MultiContainerStore:
     def delete_container(self, cid: int) -> None:
         self._vs.volume_of_cid(cid).containers.delete_container(cid)
 
+    def sealed_file_bytes(self, cid: int) -> bytes | None:
+        return self._vs.volume_of_cid(cid).containers.sealed_file_bytes(cid)
+
+    def drop_sealed_file(self, cid: int) -> int:
+        return self._vs.volume_of_cid(cid).containers.drop_sealed_file(cid)
+
     def has_container(self, cid: int, need_bytes: int = 0) -> bool:
         try:
             v = self._vs.volume_of_cid(cid)
@@ -516,3 +522,23 @@ class MultiContainerStore:
     def _on_delete(self, fn) -> None:
         for v in self._vs.volumes:
             v.containers._on_delete = fn
+
+    @property
+    def _stripe_fallback(self):
+        return self._vs.volumes[0].containers._stripe_fallback
+
+    @_stripe_fallback.setter
+    def _stripe_fallback(self, fn) -> None:
+        # stripes are DN-wide (stripe_store.py keys by owner dn_id), so one
+        # fallback serves every volume's store
+        for v in self._vs.volumes:
+            v.containers._stripe_fallback = fn
+
+    @property
+    def _stripe_probe(self):
+        return self._vs.volumes[0].containers._stripe_probe
+
+    @_stripe_probe.setter
+    def _stripe_probe(self, fn) -> None:
+        for v in self._vs.volumes:
+            v.containers._stripe_probe = fn

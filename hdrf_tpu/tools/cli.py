@@ -13,6 +13,7 @@ dispatch; DFSAdmin.java:441, OfflineImageViewer / OfflineEditsViewer under
                            -chmod -chown -getfacl -setfacl -setfattr -getfattr
   mover                    migrate replicas to satisfy storage policies
   dfsadmin                 -report -savenamespace -metrics -slowPeers
+                           -ecStatus
                            -movblock -setBalancerBandwidth -provide
                            -allowSnapshot -setQuota -setSpaceQuota -clrQuota
                            -safemode -decommission -decommissionStatus
@@ -286,6 +287,23 @@ def cmd_dfsadmin(args) -> int:
             # the outlier detector's verdict (slow_nodes_report) — peers
             # AND volumes, with the medians they were judged against
             print(json.dumps(c._call("slow_nodes_report"), indent=2))
+        elif args.op == "-ecStatus":
+            # cold-tier census: striped vs replicated containers and the
+            # stripe tier's physical/logical ratio vs replication
+            es = c._call("ec_status")
+            print(f"EC policy: {es['policy']} "
+                  f"(demote_after_s={es['demote_after_s']})")
+            print(f"Demoted blocks: {es['demoted_blocks']} "
+                  f"(pending_demotions={es['pending_demotions']} "
+                  f"pending_stripe_repairs={es['pending_stripe_repairs']})")
+            print(f"Containers: striped={es['striped_containers']} "
+                  f"replicated={es['replicated_containers']} "
+                  f"stripe_groups={es['stripe_groups']}")
+            print(f"Stripe tier: logical={es['stripe_logical_bytes']} "
+                  f"physical={es['stripe_physical_bytes']} "
+                  f"ratio={es['storage_ratio_striped']:.2f}x "
+                  f"(replicated tier: "
+                  f"{es['storage_ratio_replicated']:.1f}x)")
         elif args.op == "-finalizeUpgrade":
             r = c._call("finalize_upgrade")
             print(f"finalized: namenode={r['namenode_finalized']} "

@@ -44,8 +44,14 @@ def test_dfs_pipeline_ab_one_json_line():
 
 
 def test_ec_throughput():
-    out = run(["ec", "--mb", "3", "--policy", "rs-3-2-4k"])
-    assert len(out) == 4
+    # PR 8 contract: the ec harness prints ONE JSON line — the paired
+    # encode/intact/degraded slope report, oracle-pinned before timing
+    out = run(["ec", "--mb", "3", "--policy", "rs-3-2-4k", "--inner", "2"])
+    assert len(out) == 1
+    (o,) = out
+    assert o["parity_oracle_ok"] is True
+    assert o["k"] == 3 and o["m"] == 2
+    assert o["encode_MBps"] > 0 and o["degraded_read_MBps"] > 0
 
 
 def test_reduction_throughput():

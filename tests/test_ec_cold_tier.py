@@ -1,0 +1,350 @@
+"""EC cold tier: striped containers, degraded reads, stripe repair.
+
+Covers the demotion pipeline end to end against the striped-layout and
+reconstruction semantics of the reference (DFSStripedOutputStream.java:81
+striping, StripedBlockUtil.java:77 index math, StripedBlockReconstructor.
+java:41 decode-and-writeback, ErasureCodingWorker.java:55 DN repair
+executor) re-expressed over sealed containers (storage/stripe_store.py):
+
+- codec bit-identity vs the GF log/antilog host oracle (ops/rs.py:134)
+  on the 8-device CPU mesh, including non-multiple-of-k tail padding;
+- torn-manifest WAL replay (index/chunk_index.py record_stripe framing);
+- cluster demotion: 3x replicas -> (k+m)/k stripes, accounting ratio,
+  degraded reads with one and two stripe holders failing mid-read
+  (fault points "stripe.read" / "stripe.repair"), background repair.
+"""
+
+import io
+import itertools
+import json
+import time
+import urllib.request
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+from hdrf_tpu import native
+from hdrf_tpu.index.chunk_index import ChunkIndex
+from hdrf_tpu.ops import rs
+from hdrf_tpu.storage import stripe_store
+from hdrf_tpu.tools import cli
+from hdrf_tpu.utils import fault_injection, metrics, wal
+
+_EC = metrics.registry("ec")
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fault_injection.clear()
+    yield
+    fault_injection.clear()
+
+
+def run_cli(argv) -> tuple[int, str]:
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(argv)
+    return rc, buf.getvalue()
+
+
+# ----------------------------------------------------------- codec oracle
+
+
+class TestStripeCodec:
+    K, M = 6, 3
+
+    def _payload(self, n: int, seed: int = 0) -> bytes:
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+    def test_encode_parity_matches_gf_oracle(self):
+        """Parity stripes are bit-identical to the numpy GF log/antilog
+        oracle (rs.encode_ref) — including a tail that pads."""
+        for n in (6 * 1024, 6 * 1024 + 1, 6 * 1000 - 5):
+            payload = self._payload(n, seed=n)
+            stripes, man = stripe_store.encode_container(payload, self.K,
+                                                         self.M)
+            sl = man["stripe_len"]
+            padded = payload + b"\x00" * (self.K * sl - n)
+            data = np.frombuffer(padded, dtype=np.uint8).reshape(self.K, sl)
+            ref = rs.encode_ref(data, self.M)
+            for i in range(self.M):
+                assert stripes[self.K + i] == ref[i].tobytes(), \
+                    f"parity {i} diverged from GF oracle at n={n}"
+            assert man["length"] == n
+            for i, s in enumerate(stripes):
+                assert native.crc32c(s) == man["crcs"][i]
+
+    def test_reconstruct_from_any_k_survivors(self):
+        """Up to m=3 lost stripes (any pattern, data and parity mixed):
+        reconstruction is bit-identical to the original sealed bytes."""
+        payload = self._payload(6 * 512 + 7, seed=2)
+        stripes, man = stripe_store.encode_container(payload, self.K, self.M)
+        for lost in itertools.combinations(range(self.K + self.M), 3):
+            got = {i: stripes[i] for i in range(self.K + self.M)
+                   if i not in lost}
+            out = stripe_store.reconstruct_container(got, man)
+            assert out == payload, f"erasure pattern {lost} diverged"
+
+    def test_tail_padding_edges(self):
+        """Lengths around the k boundary: 0, 1, k-1, k, k+1, and a
+        multi-cell tail — the manifest's true length trims the zero pad."""
+        k = self.K
+        for n in (0, 1, k - 1, k, k + 1, k * 257 - 1, k * 257, k * 257 + 1):
+            payload = self._payload(n, seed=100 + n)
+            stripes, man = stripe_store.encode_container(payload, k, self.M)
+            assert man["stripe_len"] >= 1
+            # worst case: drop the first m stripes (all-data erasures)
+            got = {i: stripes[i] for i in range(self.M, k + self.M)}
+            assert stripe_store.reconstruct_container(got, man) == payload
+
+    def test_corrupt_stripe_is_an_erasure(self):
+        """A CRC-failing stripe is treated as an erasure, not input; with
+        fewer than k intact stripes reconstruction refuses (StripeCorrupt)."""
+        payload = self._payload(6 * 300, seed=3)
+        stripes, man = stripe_store.encode_container(payload, self.K, self.M)
+        bad = bytearray(stripes[0])
+        bad[5] ^= 0xFF
+        offered = {i: stripes[i] for i in range(self.K + self.M)}
+        offered[0] = bytes(bad)
+        errs0 = _EC.counter("stripe_crc_errors")
+        assert stripe_store.reconstruct_container(offered, man) == payload
+        assert _EC.counter("stripe_crc_errors") > errs0
+        # k offered but one corrupt -> only k-1 intact -> refuse
+        short = {i: stripes[i] for i in range(self.K)}
+        short[0] = bytes(bad)
+        with pytest.raises(stripe_store.StripeCorrupt):
+            stripe_store.reconstruct_container(short, man)
+
+    def test_degraded_read_counter_semantics(self):
+        """Losing only parity is NOT a degraded read (no decode); losing a
+        data stripe decodes through parity and counts."""
+        payload = self._payload(6 * 64, seed=4)
+        stripes, man = stripe_store.encode_container(payload, self.K, self.M)
+        before = _EC.counter("degraded_reads")
+        got = {i: stripes[i] for i in range(self.K)}  # all data, no parity
+        assert stripe_store.reconstruct_container(got, man) == payload
+        assert _EC.counter("degraded_reads") == before
+        got = {i: stripes[i] for i in range(1, self.K + 1)}  # data 0 lost
+        assert stripe_store.reconstruct_container(got, man) == payload
+        assert _EC.counter("degraded_reads") == before + 1
+
+    def test_storage_ratio_is_three_halves(self):
+        """Acceptance pin: RS(6,3) stripes cost ~1.5x the logical sealed
+        bytes (vs the replicated tier's 3x)."""
+        payload = self._payload((1 << 16) + 11, seed=5)
+        _stripes, man = stripe_store.encode_container(payload, 6, 3)
+        ratio = (6 + 3) * man["stripe_len"] / man["length"]
+        assert 1.49 <= ratio <= 1.51
+
+
+# ------------------------------------------------- manifest WAL durability
+
+
+class TestManifestWal:
+    MANIFEST = {"k": 3, "m": 2, "length": 1000, "stripe_len": 334,
+                "crcs": [1, 2, 3, 4, 5], "owner": "dn-0", "usize": 4096,
+                "holders": [["dn-0", "127.0.0.1", 1], ["dn-1", "127.0.0.1", 2],
+                            ["dn-2", "127.0.0.1", 3], ["dn-3", "127.0.0.1", 4],
+                            ["dn-4", "127.0.0.1", 5]]}
+
+    def test_torn_manifest_tail_is_dropped_on_replay(self, tmp_path):
+        """A crash mid-append of a stripe record must not poison recovery:
+        the committed manifest survives, the torn tail is discarded."""
+        d = str(tmp_path / "idx")
+        idx = ChunkIndex(d)
+        idx.record_stripe(7, self.MANIFEST)
+        idx.close()
+        # simulate a torn second stripe record: valid header, short payload
+        torn = wal.frame(b"x" * 512)[:-200]
+        with open(tmp_path / "idx" / "index.wal", "ab") as f:
+            f.write(torn)
+        idx2 = ChunkIndex(d)
+        try:
+            man = idx2.stripe_manifest(7)
+            assert man is not None
+            assert man["length"] == 1000 and man["k"] == 3
+            assert man["holders"][1][0] == "dn-1"
+            assert idx2.stripe_manifest(8) is None
+        finally:
+            idx2.close()
+
+    def test_unstripe_replays(self, tmp_path):
+        d = str(tmp_path / "idx")
+        idx = ChunkIndex(d)
+        idx.record_stripe(7, self.MANIFEST)
+        idx.drop_stripe(7)
+        idx.close()
+        idx2 = ChunkIndex(d)
+        try:
+            assert idx2.stripe_manifest(7) is None
+            assert idx2.stats()["striped_containers"] == 0
+        finally:
+            idx2.close()
+
+
+# --------------------------------------------------------- cluster e2e
+
+
+@pytest.fixture
+def cold_cluster():
+    """5 DNs, small containers (roll+seal while the test runs), RS(3,2)
+    cold tier armed but demotion disabled until the test flips the knob."""
+    from hdrf_tpu.testing.minicluster import MiniCluster
+
+    with MiniCluster(n_datanodes=5, block_size=256 * 1024,
+                     container_size=32 * 1024) as mc:
+        mc.namenode.config.ec_data_shards = 3
+        mc.namenode.config.ec_parity_shards = 2
+        mc.namenode.config.ec_demote_after_s = 0.0
+        yield mc
+
+
+def _wait(pred, timeout=20.0, interval=0.1, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _owner_dn(mc):
+    for dn in mc.datanodes:
+        if dn is not None and dn.index.stats()["striped_containers"] > 0:
+            return dn
+    return None
+
+
+class TestColdTierCluster:
+    def test_demote_degraded_read_and_repair(self, cold_cluster):
+        mc = cold_cluster
+        rng = np.random.default_rng(17)
+        data = rng.integers(0, 256, size=200_000, dtype=np.uint8).tobytes()
+        with mc.client("cold") as c:
+            c.write("/cold/a", data, scheme="dedup_lz4")
+            assert c.read("/cold/a") == data
+
+            # ---- demotion: 3x replicas -> (k+m)/k stripes --------------
+            mc.namenode.config.ec_demote_after_s = 0.3
+            time.sleep(0.3)
+            _wait(lambda: c._call("ec_status")["demoted_blocks"] >= 1,
+                  msg="block demotion")
+            # the census aggregates DN heartbeat stats — allow one beat
+            _wait(lambda: c._call("ec_status")["striped_containers"] >= 1,
+                  msg="striped-container census")
+            es = c._call("ec_status")
+            assert es["policy"] == "rs-3-2"
+            assert es["striped_containers"] >= 1
+            assert es["stripe_groups"] >= 1
+            # accounting: stripe tier costs ~(3+2)/3 = 1.67x vs 3x before
+            assert 1.6 <= es["storage_ratio_striped"] <= 1.75
+            assert es["storage_ratio_replicated"] == 3.0
+            # the demoted block wants ONE full replica (the stripe owner)
+            _wait(lambda: all(
+                len(b["locations"]) == 1
+                for b in c._call("get_block_locations",
+                                 path="/cold/a")["blocks"]),
+                  msg="replica invalidation down to the owner")
+
+            owner = _owner_dn(mc)
+            assert owner is not None
+            manifests = owner.index.stripe_manifests()
+            assert manifests
+            # sealed files were dropped on the owner; bytes must still read
+            assert c.read("/cold/a") == data
+
+            # ---- degraded reads: kill holders mid-read -----------------
+            # restart the owner so the container cache is cold and every
+            # read goes through sealed-file -> stripe-gather fallback
+            oid = int(owner.dn_id.split("-")[1])
+            mc.stop_datanode(oid)
+            mc.restart_datanode(oid)
+            mc.wait_for_datanodes(5)
+            owner = mc.datanodes[oid]
+            man = next(iter(owner.index.stripe_manifests().values()))
+            k = int(man["k"])
+            data_holders = [man["holders"][i][0] for i in range(k)]
+            victims = [d for d in data_holders if d != owner.dn_id]
+
+            def _boom(lost):
+                def handler(dn_id=None, **kw):
+                    if dn_id in lost:
+                        raise ConnectionError(
+                            f"injected stripe holder loss on {dn_id}")
+                return handler
+
+            # one data-stripe holder down: decode through parity
+            before = _EC.counter("degraded_reads")
+            with fault_injection.inject("stripe.read", _boom(victims[:1])):
+                assert c.read("/cold/a") == data
+            assert _EC.counter("degraded_reads") > before
+
+            # two holders down (the full parity budget of RS(3,2)):
+            # still bit-identical
+            mc.stop_datanode(oid)
+            mc.restart_datanode(oid)
+            mc.wait_for_datanodes(5)
+            before = _EC.counter("degraded_reads")
+            with fault_injection.inject("stripe.read", _boom(victims[:2])):
+                assert c.read("/cold/a") == data
+            assert _EC.counter("degraded_reads") > before
+
+            # ---- background stripe repair ------------------------------
+            repair_fired = []
+            fault_injection.install(
+                "stripe.repair",
+                lambda dn_id=None, **kw: repair_fired.append(dn_id))
+            owner = mc.datanodes[int(_owner_dn(mc).dn_id.split("-")[1])]
+            man = next(iter(owner.index.stripe_manifests().values()))
+            dead = next(h[0] for h in man["holders"] if h[0] != owner.dn_id)
+            repaired0 = _EC.counter("stripes_repaired")
+            mc.stop_datanode(int(dead.split("-")[1]))
+            _wait(lambda: _EC.counter("stripes_repaired") > repaired0,
+                  timeout=25.0, msg="stripe repair")
+            assert repair_fired and repair_fired[0] == owner.dn_id
+            assert _EC.counter("repair_bytes") > 0
+            # post-repair: manifest holders no longer reference the dead DN
+            _wait(lambda: all(
+                h[0] != dead
+                for m in owner.index.stripe_manifests().values()
+                for h in m["holders"]), msg="holder re-registration")
+            assert c.read("/cold/a") == data
+
+    def test_ec_status_cli_and_gateway_rows(self, cold_cluster):
+        mc = cold_cluster
+        rng = np.random.default_rng(23)
+        data = rng.integers(0, 256, size=120_000, dtype=np.uint8).tobytes()
+        with mc.client("ops") as c:
+            c.write("/cold/b", data, scheme="dedup_lz4")
+            mc.namenode.config.ec_demote_after_s = 0.3
+            time.sleep(0.3)
+            _wait(lambda: c._call("ec_status")["demoted_blocks"] >= 1,
+                  msg="block demotion")
+            _wait(lambda: c._call("ec_status")["striped_containers"] >= 1,
+                  msg="striped-container census")
+
+        nn = f"{mc.namenode.addr[0]}:{mc.namenode.addr[1]}"
+        rc, out = run_cli(["dfsadmin", "--namenode", nn, "-ecStatus"])
+        assert rc == 0
+        assert "EC policy: rs-3-2" in out
+        assert "striped=" in out and "ratio=" in out
+
+        from hdrf_tpu.server.http_gateway import HttpGateway
+        gw = HttpGateway(mc.namenode.addr).start()
+        try:
+            base = f"http://{gw.addr[0]}:{gw.addr[1]}"
+            with urllib.request.urlopen(base + "/status", timeout=10) as r:
+                st = json.loads(r.read())
+            assert st["striped_containers"] >= 1
+            assert st["ec_demoted_blocks"] >= 1
+            assert st["stripe_physical_bytes"] > st["stripe_logical_bytes"]
+            with urllib.request.urlopen(base + "/health", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["striped_containers"] >= 1
+            with urllib.request.urlopen(base + "/prom", timeout=10) as r:
+                prom = r.read().decode()
+            assert 'hdrf_stripes_encoded_total{registry="ec"}' in prom
+        finally:
+            gw.stop()

@@ -309,5 +309,6 @@ def test_stats(tmp_path):
     idx.commit_block(1, 100, [h(1), h(1)], {h(1): (0, 0, 50)})
     s = idx.stats()
     assert s == {"blocks": 1, "chunks": 1, "sealed_containers": 0,
+                 "striped_containers": 0,
                  "logical_bytes": 100, "unique_chunk_bytes": 50}
     idx.close()

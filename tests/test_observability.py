@@ -478,3 +478,33 @@ class TestBenchContract:
         assert int(pl["depth"]) >= 1
         assert int(pl["group_commit_batches"]) >= 0
         assert 0.0 <= float(pl["overlap_efficiency"]) <= 1.0
+        # EC cold-tier stamp: the in-bench RS(6,3) exercise encodes one
+        # container (9 stripes) and reads it back degraded (all-data
+        # erasures -> decode through parity), so both counters are live;
+        # the tier's expansion sits at ~(k+m)/k = 1.5
+        ec = doc["ec"]
+        assert int(ec["stripes_encoded"]) >= 9
+        assert int(ec["degraded_reads"]) >= 1
+        assert int(ec["repair_bytes"]) >= 0
+        assert 1.49 <= float(ec["storage_ratio"]) <= 1.51
+
+    def test_benchmarks_ec_one_json_line(self):
+        """python -m hdrf_tpu.benchmarks ec: the paired encode / intact /
+        degraded-read slope harness prints exactly ONE JSON line, with the
+        parity pinned against the GF log/antilog oracle before timing."""
+        from hdrf_tpu.utils.cleanenv import clean_cpu_env
+        env = clean_cpu_env(8, keep_existing_count=True)
+        out = subprocess.run(
+            [sys.executable, "-m", "hdrf_tpu.benchmarks", "ec",
+             "--mb", "2", "--inner", "2"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+        assert out.returncode == 0, out.stderr[-2000:]
+        lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, f"stdout must be ONE line, got {lines!r}"
+        doc = json.loads(lines[0])
+        assert doc["parity_oracle_ok"] is True
+        assert doc["k"] == 6 and doc["m"] == 3
+        for key in ("encode_MBps", "intact_read_MBps",
+                    "degraded_read_MBps"):
+            assert float(doc[key]) > 0, key
+        assert 1.49 <= float(doc["storage_ratio"]) <= 1.51

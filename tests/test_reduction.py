@@ -103,7 +103,9 @@ class TestDedup:
         assert ctx.index.stats()["chunks"] > 0
         s.delete(1, ctx)
         assert ctx.index.stats() == {"blocks": 0, "chunks": 0,
-                                     "sealed_containers": 0, "logical_bytes": 0,
+                                     "sealed_containers": 0,
+                                     "striped_containers": 0,
+                                     "logical_bytes": 0,
                                      "unique_chunk_bytes": 0}
 
     def test_survives_container_rollover(self, tmp_path):
