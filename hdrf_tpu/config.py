@@ -126,6 +126,20 @@ class ReductionConfig:
     # Admission bound on blocks simultaneously inside the pipeline
     # (admitted-but-uncommitted); backpressures client streams beyond it.
     pipeline_max_inflight: int = 8
+    # Mesh-sharded reduction plane (parallel/sharded.MeshReducer): when
+    # True and >1 device is attached, coalesced groups run CDC+SHA+dedup
+    # probe as ONE dispatch per mesh step, blocks data-parallel over the
+    # whole mesh, with the device-resident sharded fingerprint bucket
+    # table answering the dedup probe on-mesh.  The single-device serial
+    # path stays verbatim as the bit-identity oracle.
+    mesh_plane: bool = False
+    # Per-device lane capacity: a mesh step coalesces up to
+    # n_devices * mesh_lanes_per_device blocks.
+    mesh_lanes_per_device: int = 2
+    # Bucket slots PER DEVICE in the sharded fingerprint table (u32 pairs;
+    # 2^15 slots = 256 KiB/device).  Collisions only cost a host re-check
+    # or a duplicate append — never correctness.
+    mesh_bucket_slots: int = 1 << 15
     cdc: CdcConfig = field(default_factory=CdcConfig)
 
 

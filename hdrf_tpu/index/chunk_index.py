@@ -127,6 +127,11 @@ class ChunkIndex:
         self._gc_cv = threading.Condition()
         self._gc_entries: list[_GCEntry] = []
         self._gc_leader = False
+        # commit listeners fire inside _apply's b"blk" branch with the
+        # record's first-seen fingerprints (the sharded bucket table's
+        # incremental refresh feed) — registered before _recover() so
+        # replay-applied records also notify.
+        self._listeners: list = []
         self._recover()
         self._wal = open(os.path.join(directory, WAL_NAME), "ab")
 
@@ -164,6 +169,13 @@ class ChunkIndex:
             for h in hashes:
                 self._chunks[h].refcount += 1
             self._blocks[bid] = BlockEntry(llen, list(hashes))
+            if self._listeners and new_chunks:
+                fps = list(new_chunks)
+                for fn in self._listeners:
+                    try:
+                        fn(fps)
+                    except Exception:  # noqa: BLE001 — advisory feed; a bad
+                        pass  # listener must never fail the durable commit
         elif op == b"del":  # [op, block_id]
             entry = self._blocks.pop(rec[1], None)
             if entry:
@@ -222,6 +234,15 @@ class ChunkIndex:
             self._checkpoint_locked()
 
     # ------------------------------------------------------------- mutation
+
+    def add_commit_listener(self, fn) -> None:
+        """Register ``fn(fingerprints: list[bytes])`` to run on every block
+        commit with that record's FIRST-SEEN chunk fingerprints (after the
+        record is durable + applied).  Advisory: exceptions are swallowed,
+        delivery is at-least-once across recovery replay.  Feeds the mesh
+        plane's device-resident bucket table (parallel/sharded.py)."""
+        with self._lock:
+            self._listeners.append(fn)
 
     def lookup_chunks(self, hashes: list[bytes]) -> dict[bytes, ChunkLocation | None]:
         """Batch fingerprint probe — the reference's per-thread Redis MULTI GET

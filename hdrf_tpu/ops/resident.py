@@ -155,14 +155,16 @@ def _prep_batch(blocks: jax.Array, mask: int, cap: int, pad_words: int):
             jnp.stack([c for _, c in outs]))
 
 
-@functools.partial(jax.jit, static_argnames=("bucket",))
-def _bucket_sha(words: jax.Array, ol: jax.Array, bucket: int) -> jax.Array:
-    """Gather + byte-align + SHA-pad + hash one size bucket of chunks.
+def sha_pad_messages(words: jax.Array, ol: jax.Array,
+                     bucket: int) -> tuple[jax.Array, jax.Array]:
+    """Gather + byte-align + SHA-pad one size bucket of chunks into padded
+    message words (no hashing).  Shared by :func:`_bucket_sha` and the
+    mesh-sharded reduction step (parallel/sharded.py), which hashes the
+    same messages per shard under shard_map.
 
     words: u32[NW] resident BE word image (zero-padded so no slice clamps).
-    ol: i32[2, L] — row 0 chunk byte offsets, row 1 chunk byte lengths
-    (one packed upload: each tiny H2D pays a fixed tunnel cost),
-    lens + 9 <= bucket * 64.  Returns u8[L, 32].
+    ol: i32[2, L] — row 0 chunk byte offsets, row 1 chunk byte lengths,
+    lens + 9 <= bucket * 64.  Returns (msgs u32[L, bucket*16], nb i64[L]).
     """
     offs, lens = ol[0], ol[1]
     W = bucket * 16  # u32 words per lane
@@ -188,6 +190,19 @@ def _bucket_sha(words: jax.Array, ol: jax.Array, bucket: int) -> jax.Array:
     last = nb * 16 - 1
     bitlen = (lens.astype(jnp.uint32) * 8)[:, None]
     out = jnp.where(j == last[:, None], bitlen, out)
+    return out, nb
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def _bucket_sha(words: jax.Array, ol: jax.Array, bucket: int) -> jax.Array:
+    """Gather + byte-align + SHA-pad + hash one size bucket of chunks.
+
+    words: u32[NW] resident BE word image (zero-padded so no slice clamps).
+    ol: i32[2, L] — row 0 chunk byte offsets, row 1 chunk byte lengths
+    (one packed upload: each tiny H2D pays a fixed tunnel cost),
+    lens + 9 <= bucket * 64.  Returns u8[L, 32].
+    """
+    out, nb = sha_pad_messages(words, ol, bucket)
     if jax.default_backend() == "cpu":
         return sha256_words(out, nb.astype(jnp.int32))
     from hdrf_tpu.ops.sha256_pallas import sha256_words_pallas
@@ -295,6 +310,13 @@ class BatchJob:
     plan: object = None               # cdc_pallas.FusedPlan
     _digs: jax.Array | None = None    # (K*Ls + K*Lb, 32) fused digests
     _host: list | None = None         # host u8 blocks for overflow fallback
+    # Mixed-size groups (bucket-padded coalescing): per-block unpadded
+    # lengths; None means every block is true_n bytes.
+    true_ns: list[int] | None = None
+
+
+def _host_sizes(datas) -> list[int]:
+    return [d.size if isinstance(d, np.ndarray) else len(d) for d in datas]
 
 
 @dataclasses.dataclass
@@ -365,13 +387,19 @@ class ResidentReducer:
         Host-byte groups route through the fused Pallas CDC kernel when
         enabled (cuts selected on device, SHA enqueued with no candidate
         readback); device-resident inputs and ``fused == 'off'`` take the
-        XLA prep + host-select path.
+        XLA prep + host-select path.  Mixed-length host groups (the
+        bucket-padded coalescer) always take the XLA path, padded to the
+        longest member — the fused kernel's plan is per-length.
         """
         if self.fused != "off":
-            return self._submit_many_fused(datas)
+            if isinstance(datas, jax.Array) or len(
+                    set(_host_sizes(datas))) == 1:
+                return self._submit_many_fused(datas)
         return self._submit_many_xla(datas)
 
     def _submit_many_xla(self, datas) -> BatchJob:
+        pad_extra = 0
+        true_ns = None
         if isinstance(datas, jax.Array):
             k, n = datas.shape
             assert n > 0 and n % _PAD_GRID == 0
@@ -380,14 +408,24 @@ class ResidentReducer:
         else:
             arrs = [np.frombuffer(d, dtype=np.uint8)
                     if not isinstance(d, np.ndarray) else d for d in datas]
-            true_n = arrs[0].size
-            assert all(a.size == true_n for a in arrs), \
-                "submit_many needs equal lengths"
+            true_ns = [a.size for a in arrs]
+            true_n = max(true_ns)
             assert true_n > 0
-            pad = (-true_n) % _PAD_GRID
-            if pad:
-                arrs = [np.concatenate([a, np.zeros(pad, np.uint8)])
+            n_pad = true_n + (-true_n) % _PAD_GRID
+            if any(a.size != n_pad for a in arrs):
+                arrs = [a if a.size == n_pad
+                        else np.concatenate(
+                            [a, np.zeros(n_pad - a.size, np.uint8)])
                         for a in arrs]
+            if min(true_ns) != true_n:
+                # A shorter member's zero tail is a DENSE candidate region
+                # (the gear hash of zeros is zero, and 0 & mask == 0): one
+                # candidate word per 32 pad bytes must fit the packed
+                # readback, or every mixed group would pay the prep_retry
+                # round trip the capacity formula exists to avoid.
+                pad_extra = (n_pad - min(true_ns)) // 32 + 2
+            else:
+                true_ns = None
             stacked = jax.device_put(np.stack(arrs))
             k, n = stacked.shape
         # int32 flat-byte-offset headroom for the bucket gather
@@ -395,7 +433,7 @@ class ResidentReducer:
             "batch too large for i32 flat offsets; split it"
         cap = max(1, min(n // 32,
                          max(1024, (n >> max(self.cdc.mask_bits - 1, 0))
-                             + 1024)))
+                             + 1024) + pad_extra))
         ev = _ledger.dispatch(
             "resident.prep_batch", batch=k,
             h2d_bytes=0 if isinstance(datas, jax.Array) else k * n,
@@ -403,7 +441,7 @@ class ResidentReducer:
         words, cand = _prep_batch(stacked, self.mask, cap, self.pad_words)
         cand.copy_to_host_async()
         return BatchJob(k=k, n=n, blocks=stacked, words=words, cand=cand,
-                        cap=cap, true_n=true_n, _ev=ev)
+                        cap=cap, true_n=true_n, true_ns=true_ns, _ev=ev)
 
     def _submit_many_fused(self, datas) -> BatchJob:
         """Fused-kernel group submit: ONE program selects cuts on device
@@ -507,6 +545,7 @@ class ResidentReducer:
             bj.fused = False
             bj._host = None
             bj.n, bj.true_n, bj.cap = nj.n, nj.true_n, nj.cap
+            bj.true_ns = nj.true_ns
             bj.blocks, bj.words, bj.cand = nj.blocks, nj.words, nj.cand
             bj._ev = nj._ev
             self.start_sha_many(bj)
@@ -539,8 +578,8 @@ class ResidentReducer:
         bj._ev = None
         cuts_all, starts_all, lens_all = [], [], []
         for k in range(bj.k):
-            cuts = self._cuts_from_cand(cand[k], bj.cap, bj.blocks[k],
-                                        bj.true_n)
+            tn = bj.true_ns[k] if bj.true_ns is not None else bj.true_n
+            cuts = self._cuts_from_cand(cand[k], bj.cap, bj.blocks[k], tn)
             starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
             cuts_all.append(cuts)
             starts_all.append(starts)

@@ -23,7 +23,9 @@ Cross-device reductions (candidate counts, byte stats) ride ``psum``.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -37,9 +39,13 @@ except ImportError:  # pragma: no cover - older jax
 
 from hdrf_tpu.ops import gear
 from hdrf_tpu.utils import device_ledger as _ledger
+from hdrf_tpu.utils import fault_injection
+from hdrf_tpu.utils import metrics as _metrics
 
 WINDOW = gear.WINDOW
 _HALO = WINDOW - 1
+
+_MP = _metrics.registry("mesh_plane")
 
 
 def _put_global(arr: np.ndarray, sharding) -> jax.Array:
@@ -454,3 +460,537 @@ def gear_candidates_sharded(data: bytes | np.ndarray, mask: int,
     (idx,) = np.nonzero(wv)
     pos = gear._words_to_positions(idx.astype(np.uint32), wv[idx], n)
     return pos
+
+
+# --------------------------------------------------------------------------
+# Mesh-sharded reduction plane: a coalesced write-pipeline group becomes ONE
+# ledger-visible dispatch per mesh step.  Blocks are data-parallel over
+# 'data'; each device runs CDC cut selection, SHA-256 of both lane buckets,
+# and its partition of the dedup bucket probe; an all_gather + psum makes
+# every probe verdict replicated.  The serial ResidentReducer stays verbatim
+# as the bit-identity oracle (asserted in tests/test_mesh_plane.py).
+# --------------------------------------------------------------------------
+
+
+def _select_cuts_dev(cw: jax.Array, true_n: jax.Array, mn: int, mx: int,
+                     cap: int) -> tuple[jax.Array, jax.Array]:
+    """Device-side greedy CDC cut selection over the packed candidate
+    bitmap — bit-identical to native.cdc_select (cdc.cpp:73-88): per chunk
+    the cut is the first candidate in [prev+min, min(prev+max, n)], else
+    the upper bound; the final cut is always ``n``.
+
+    A ``lax.scan`` walks the 32-position bitmap words carrying (prev cut,
+    emitted count, cut table); an inner static loop of C iterations emits
+    every cut that can land inside one word (cuts advance >= min_chunk
+    apart, plus one short final chunk, so C = 32//min + 2 bounds it).  The
+    zero-pad tail past ``true_n`` is a dense candidate region (the gear
+    hash of zeros is zero) but can never be selected: candidates must sit
+    <= hi <= true_n.  Returns (cuts i32[cap] ascending, count i32)."""
+    nw = cw.shape[0]
+    C = max(1, min(32, 32 // max(mn, 1) + 2))
+    tn = true_n.astype(jnp.int32)
+
+    def step(carry, xw):
+        prev, cnt, tbl = carry
+        widx, w = xw
+        base = widx * 32
+        word_end = base + 32
+        for _ in range(C):
+            active = prev < tn
+            lo = prev + mn
+            hi = jnp.minimum(prev + mx, tn)
+            sh = jnp.clip(lo - base - 1, 0, 32)
+            keep = jnp.where(
+                sh >= 32, jnp.uint32(0),
+                jnp.uint32(0xFFFFFFFF)
+                << jnp.minimum(sh, 31).astype(jnp.uint32))
+            wm = w & keep
+            low = wm & (~wm + jnp.uint32(1))     # lowest set bit
+            bitpos = jnp.int32(31) - jax.lax.clz(low).astype(jnp.int32)
+            cand_pos = base + bitpos + 1         # bit k <-> pos1 = base+k+1
+            has_cand = (wm != jnp.uint32(0)) & (cand_pos <= hi)
+            # Forced cut at hi fires only in hi's own word: an earlier word
+            # cannot rule out candidates it does not cover.  No lo <= hi
+            # guard — a tail shorter than min_chunk still cuts at n.
+            forced = active & ~has_cand & (hi <= word_end)
+            emit = active & (has_cand | forced)
+            cut = jnp.where(has_cand, cand_pos, hi)
+            tbl = tbl.at[jnp.where(emit, cnt, cap)].set(cut, mode="drop")
+            cnt = cnt + emit.astype(jnp.int32)
+            prev = jnp.where(emit, cut, prev)
+        return (prev, cnt, tbl), None
+
+    init = (jnp.int32(0), jnp.int32(0), jnp.zeros((cap,), jnp.int32))
+    # Modest unroll amortizes XLA:CPU's per-iteration scan overhead (the
+    # dominant cost for small blocks); full unroll risks the compile
+    # blowups PERF_NOTES warns about, 8 stays well clear.
+    (_, cnt, tbl), _ = jax.lax.scan(
+        step, init, (jnp.arange(nw, dtype=jnp.int32), cw),
+        unroll=min(nw, 8))
+    return tbl, cnt
+
+
+def _fp_hi_lo(fp_u8: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """First 8 digest bytes as two big-endian u32 keys — the numpy mirror
+    of the on-mesh probe's key math (MUST stay bit-identical to the step
+    fn and the ShardedBucketTable refresh)."""
+    u = fp_u8.astype(np.uint32)
+    hi = (u[:, 0] << 24) | (u[:, 1] << 16) | (u[:, 2] << 8) | u[:, 3]
+    lo = (u[:, 4] << 24) | (u[:, 5] << 16) | (u[:, 6] << 8) | u[:, 7]
+    return hi, lo
+
+
+_PROBE_MULT = 2654435761  # Knuth multiplicative hash, u32 wraparound
+
+
+_mesh_step_fns = _LruJitCache()
+
+
+def _mesh_step(mesh: Mesh, Kl: int, n_pad: int, mn: int, mx: int,
+               b_small: int, b_big: int, Ls: int, Lb: int, cap: int,
+               S: int):
+    """Compiled mesh-step fn: ``fn(blocks u8[K, n_pad] P('data', None),
+    true_ns i32[K] P('data'), mask u32 P(), table u32[ndata, S, 2]
+    P('data')) -> (cuts i32[K, cap], counts i32[K], digs u8[K*(Ls+Lb), 32],
+    hits i32[K*(Ls+Lb)] replicated)``.
+
+    One dispatch runs, per device: candidate bitmap -> cut-select scan ->
+    two-bucket lane binning -> SHA-256 -> all_gather(digests) -> local
+    bucket-partition probe -> psum(hit votes).  ``donate_argnums=(0,)``
+    recycles the group's HBM block buffer so memory stays flat across
+    steps.  The host reconstructs chunk order from the SAME binning rule
+    (small = padded SHA block count <= b_small, rank by running count).
+
+    ``Lb == 0`` means the geometry proves every chunk small
+    ((max_chunk+72)//64 <= b_small): the big SHA leg is elided at trace
+    time — for small-block geometries that leg is pure 128-lane-floor
+    padding and dominates the per-device compute."""
+    key = (mesh, Kl, n_pad, mn, mx, b_small, b_big, Ls, Lb, cap, S)
+    fn = _mesh_step_fns.get(key)
+    if fn is not None:
+        return fn
+    from hdrf_tpu.ops.resident import be_word_image, sha_pad_messages
+    from hdrf_tpu.ops.sha256 import sha256_words
+
+    ndata = mesh.shape["data"]
+    pw = -(-(b_big * 16 + 16) // 128) * 128   # gather window never clamps
+    stride_b = (n_pad // 4 + pw) * 4
+    # sha256_words hashes lanes on a 128-lane grid; round the per-DEVICE
+    # lane totals up to it (not each block's stride — that multiplied the
+    # padding by Kl).  Grid-pad lanes hash zero-length messages and their
+    # digest rows are sliced off before the all_gather.
+    Lst = -(-(Kl * Ls) // 128) * 128
+    Lbt = -(-(Kl * Lb) // 128) * 128 if Lb else 0
+
+    def sha_words(words, ol, bucket):
+        msgs, nb = sha_pad_messages(words, ol, bucket)
+        if jax.default_backend() == "cpu":
+            return sha256_words(msgs, nb.astype(jnp.int32))
+        from hdrf_tpu.ops.sha256_pallas import sha256_words_pallas
+
+        return sha256_words_pallas(msgs, nb.astype(jnp.int32))
+
+    def step(blocks, tns, mask, table):
+        cw = jax.vmap(lambda b: gear.candidate_bitmap_words(b, mask))(blocks)
+        cuts, counts = jax.vmap(
+            lambda w, t: _select_cuts_dev(w, t, mn, mx, cap))(cw, tns)
+        starts = jnp.concatenate(
+            [jnp.zeros((Kl, 1), jnp.int32), cuts[:, :-1]], axis=1)
+        j = jnp.arange(cap, dtype=jnp.int32)[None, :]
+        valid = j < counts[:, None]
+        lens = jnp.where(valid, cuts - starts, 0)
+        starts = jnp.where(valid, starts, 0)
+        nb = (lens + 9 + 63) // 64
+        small = valid & (nb <= b_small)
+        big = valid & ~small
+        r_s = jnp.cumsum(small.astype(jnp.int32), axis=1) - 1
+        r_b = jnp.cumsum(big.astype(jnp.int32), axis=1) - 1
+        karr = jnp.arange(Kl, dtype=jnp.int32)[:, None]
+        flat = (karr * stride_b + starts).reshape(-1)
+        lens_f = lens.reshape(-1)
+        rows_s = jnp.where(small, karr * Ls + r_s, Lst).reshape(-1)
+        ol_s = jnp.zeros((2, Lst), jnp.int32)
+        ol_s = ol_s.at[0, rows_s].set(flat, mode="drop")
+        ol_s = ol_s.at[1, rows_s].set(lens_f, mode="drop")
+        imgs = jnp.pad(jax.vmap(be_word_image)(blocks), ((0, 0), (0, pw)))
+        words = imgs.reshape(-1)
+        if Lb:
+            rows_b = jnp.where(big, karr * Lb + r_b, Lbt).reshape(-1)
+            ol_b = jnp.zeros((2, Lbt), jnp.int32)
+            ol_b = ol_b.at[0, rows_b].set(flat, mode="drop")
+            ol_b = ol_b.at[1, rows_b].set(lens_f, mode="drop")
+            digs = jnp.concatenate(
+                [sha_words(words, ol_s, b_small)[:Kl * Ls],
+                 sha_words(words, ol_b, b_big)[:Kl * Lb]], axis=0)
+        else:
+            digs = sha_words(words, ol_s, b_small)[:Kl * Ls]
+        # On-mesh dedup probe: every device sees every fingerprint (the
+        # all_gather), answers only for its own partition of fingerprint
+        # space (hi % ndata), and the psum replicates the verdicts.  Only
+        # the two probe-key words (digest bytes 0-7) cross the mesh — a
+        # 4x smaller gather than shipping full 32-byte digest rows.
+        d8 = digs[:, :8].astype(jnp.uint32)
+        keys = jnp.stack(
+            [(d8[:, 0] << 24) | (d8[:, 1] << 16) | (d8[:, 2] << 8) | d8[:, 3],
+             (d8[:, 4] << 24) | (d8[:, 5] << 16) | (d8[:, 6] << 8) | d8[:, 7]],
+            axis=1)
+        gath = jax.lax.all_gather(keys, "data", tiled=True)
+        hi = gath[:, 0]
+        lo = gath[:, 1]
+        mine = hi % jnp.uint32(ndata) == \
+            jax.lax.axis_index("data").astype(jnp.uint32)
+        slot = ((lo * jnp.uint32(_PROBE_MULT)) ^ hi) % jnp.uint32(S)
+        ent = table[0, slot]
+        hit = mine & (ent[:, 0] == hi) & (ent[:, 1] == lo)
+        hits = jax.lax.psum(hit.astype(jnp.int32), "data")
+        return cuts, counts, digs, hits
+
+    fn = jax.jit(_shard_map(
+        step, mesh=mesh,
+        in_specs=(P("data", None), P("data"), P(), P("data", None, None)),
+        out_specs=(P("data", None), P("data"), P("data", None), P()),
+        check_rep=False), donate_argnums=(0,))
+    _mesh_step_fns.put(key, fn)
+    return fn
+
+
+_bucket_upd_fns = _LruJitCache()
+
+
+def _bucket_upd_fn(mesh: Mesh, R: int, S: int):
+    """Incremental sharded bucket-table refresh: rows u32[R, 4] of
+    (owner, slot, hi, lo) arrive replicated; each device scatters only its
+    own rows (others drop out of bounds).  The table buffer is donated so
+    the refresh recycles HBM in place."""
+    key = (mesh, R, S)
+    fn = _bucket_upd_fns.get(key)
+    if fn is not None:
+        return fn
+
+    def upd(tbl, rows):
+        mine = rows[:, 0].astype(jnp.int32) == jax.lax.axis_index("data")
+        slot = jnp.where(mine, rows[:, 1].astype(jnp.int32), S)
+        tbl = tbl.at[0, slot, 0].set(rows[:, 2], mode="drop")
+        tbl = tbl.at[0, slot, 1].set(rows[:, 3], mode="drop")
+        return tbl
+
+    fn = jax.jit(_shard_map(
+        upd, mesh=mesh,
+        in_specs=(P("data", None, None), P()),
+        out_specs=P("data", None, None), check_rep=False),
+        donate_argnums=(0,))
+    _bucket_upd_fns.put(key, fn)
+    return fn
+
+
+class ShardedBucketTable:
+    """Device-resident dedup fingerprint buckets, fingerprint space
+    partitioned over the 'data' axis (owner = hi % ndata, slot = Knuth
+    multiplicative hash of the 64-bit digest prefix).
+
+    The table is a PROBE ACCELERATOR, not an authority: the ChunkIndex
+    commit listener feeds new fingerprints through :meth:`note_new`, and a
+    pending batch flushes to the device right before each mesh step.  A
+    stale or collided entry can only produce a false positive (resolved by
+    the host's authoritative index re-check) or a false negative (the
+    chunk is appended again; ChunkIndex.commit_block keeps the first
+    commit and the orphan bytes are reclaimed by compaction) — never
+    corruption.  A failed refresh (fault point ``sharded.bucket_refresh``)
+    re-queues the pending rows and the step runs with the stale table."""
+
+    def __init__(self, mesh: Mesh, slots: int = 1 << 15):
+        self.mesh = mesh
+        self.ndata = mesh.shape["data"]
+        self.slots = int(slots)
+        self._sharding = NamedSharding(mesh, P("data"))
+        self._np = np.full((self.ndata, self.slots, 2), 0xFFFFFFFF,
+                           np.uint32)
+        self._dev: jax.Array | None = None
+        self._pending: list[bytes] = []
+        self._lock = threading.Lock()
+
+    def note_new(self, fingerprints) -> None:
+        """Buffer newly committed chunk fingerprints (>= 8 bytes each) for
+        the next refresh.  Called from the ChunkIndex commit listener."""
+        with self._lock:
+            self._pending.extend(bytes(f) for f in fingerprints)
+
+    def _keys(self, fp_rows: np.ndarray):
+        hi, lo = _fp_hi_lo(fp_rows)
+        owner = hi % np.uint32(self.ndata)
+        slot = ((lo * np.uint32(_PROBE_MULT)) ^ hi) % np.uint32(self.slots)
+        return owner, slot, hi, lo
+
+    def host_probe(self, digests: np.ndarray) -> np.ndarray:
+        """Numpy mirror of the on-mesh probe (tests pin the two agree)."""
+        owner, slot, hi, lo = self._keys(digests)
+        ent = self._np[owner, slot]
+        return (ent[:, 0] == hi) & (ent[:, 1] == lo)
+
+    def flush(self) -> None:
+        with self._lock:
+            pend, self._pending = self._pending, []
+        if not pend:
+            return
+        try:
+            fault_injection.point("sharded.bucket_refresh", rows=len(pend))
+        except Exception:
+            with self._lock:
+                self._pending = pend + self._pending
+            _MP.incr("bucket_refresh_failures")
+            return
+        fps = np.frombuffer(b"".join(p[:8] for p in pend),
+                            np.uint8).reshape(-1, 8)
+        owner, slot, hi, lo = self._keys(fps)
+        self._np[owner, slot, 0] = hi
+        self._np[owner, slot, 1] = lo
+        _MP.incr("bucket_refresh_rows", len(pend))
+        if self._dev is None:
+            return
+        R = max(8, 1 << (len(pend) - 1).bit_length())  # stable jit keys
+        rows = np.full((R, 4), self.ndata, np.uint32)  # pad rows drop
+        rows[:len(pend), 0] = owner
+        rows[:len(pend), 1] = slot
+        rows[:len(pend), 2] = hi
+        rows[:len(pend), 3] = lo
+        _ledger.dispatch("sharded.bucket_refresh", batch=len(pend),
+                         h2d_bytes=rows.nbytes, key=(R, self.slots))
+        self._dev = _bucket_upd_fn(self.mesh, R, self.slots)(
+            self._dev, _put_global(rows, NamedSharding(self.mesh, P())))
+
+    def device_table(self) -> jax.Array:
+        self.flush()
+        if self._dev is None:
+            self._dev = _put_global(self._np, self._sharding)
+        return self._dev
+
+
+@dataclasses.dataclass
+class MeshJob:
+    """One in-flight mesh step (K blocks, one dispatch)."""
+    k0: int                    # real blocks (the rest pad the mesh width)
+    cap: int
+    Ls: int
+    Lb: int
+    b_small: int
+    true_ns: list[int]
+    cuts: jax.Array | None
+    counts: jax.Array | None
+    digs: jax.Array | None
+    hits: jax.Array | None
+    _ev: object = None
+
+
+class MeshReducer:
+    """Mesh-sharded group-reduction front end: the multi-chip counterpart
+    of ops.resident.ResidentReducer's batched pipeline (same submit /
+    start / finish shape, so server/write_pipeline.py drives either).
+
+    ``finish_many`` returns per block ``(cuts u64, digests u8[nc, 32],
+    probe frozenset)`` — the extra third element is the set of chunk
+    fingerprints whose on-mesh bucket probe voted HIT; reduction/dedup.py
+    skips the host index walk for everything outside it and re-checks the
+    members authoritatively."""
+
+    def __init__(self, cdc=None, mesh: Mesh | None = None,
+                 lanes_per_device: int = 2, bucket_slots: int = 1 << 15,
+                 mask: int | None = None):
+        from hdrf_tpu.config import CdcConfig
+        from hdrf_tpu.ops.dispatch import gear_mask
+
+        self.cdc = cdc or CdcConfig()
+        self.mesh = mesh if mesh is not None else \
+            make_mesh(n_data=len(jax.devices()), n_seq=1)
+        assert self.mesh.shape["seq"] == 1, \
+            "the mesh plane shards blocks over 'data' only"
+        self.ndata = self.mesh.shape["data"]
+        self.mask = gear_mask(self.cdc) if mask is None else mask
+        self.lanes_per_device = max(1, int(lanes_per_device))
+        self.table = ShardedBucketTable(self.mesh, slots=bucket_slots)
+        self._b_big = (self.cdc.max_chunk + 9 + 63) // 64
+        self._b_small = max(1, min((2 << self.cdc.mask_bits) // 64,
+                                   self._b_big))
+
+    def max_group(self, n: int = 0) -> int:
+        """Mesh width x per-device lane capacity — the coalescer's group
+        target (ISSUE 9 tentpole c)."""
+        return self.ndata * self.lanes_per_device
+
+    def submit_many(self, datas) -> MeshJob:
+        arrs = [np.frombuffer(d, dtype=np.uint8)
+                if not isinstance(d, np.ndarray) else d for d in datas]
+        true_ns = [int(a.size) for a in arrs]
+        k0 = len(arrs)
+        assert k0 > 0 and max(true_ns) > 0
+        n_pad = max(true_ns) + (-max(true_ns)) % 512
+        k = k0 + (-k0) % self.ndata   # dummy zero blocks (true_n 0) pad
+        Kl = k // self.ndata
+        buf = np.zeros((k, n_pad), dtype=np.uint8)
+        for i, a in enumerate(arrs):
+            buf[i, :a.size] = a
+        mn, mx = self.cdc.min_chunk, self.cdc.max_chunk
+        # Provable capacities — no overflow/fallback path exists or is
+        # needed: cuts advance >= min_chunk (+1 final short chunk), big
+        # chunks are > b_small*64-9 bytes by the binning rule.
+        cap = n_pad // max(mn, 1) + 2
+        # Per-block lane strides (the 128-lane SHA grid is applied to the
+        # per-device TOTALS inside _mesh_step).  Lb == 0 when the binning
+        # rule proves every chunk small — the big leg is elided entirely.
+        Ls = cap
+        Lb = (0 if self._b_small >= self._b_big
+              else n_pad // max(self._b_small * 64 - 8, mn, 1) + 2)
+        fn = _mesh_step(self.mesh, Kl, n_pad, mn, mx, self._b_small,
+                        self._b_big, Ls, Lb, cap, self.table.slots)
+        table_dev = self.table.device_table()   # flushes pending commits
+        blocks = _put_global(buf,
+                             NamedSharding(self.mesh, P("data", None)))
+        tns = _put_global(np.array(true_ns + [0] * (k - k0), np.int32),
+                          NamedSharding(self.mesh, P("data")))
+        ev = _ledger.dispatch("sharded.step", batch=k0,
+                              h2d_bytes=buf.nbytes,
+                              key=(Kl, n_pad, cap, self.ndata))
+        cuts, counts, digs, hits = fn(
+            blocks, tns, jnp.uint32(self.mask & 0xFFFFFFFF), table_dev)
+        for out in (cuts, counts, digs, hits):
+            out.copy_to_host_async()
+        _MP.incr("steps")
+        _MP.observe("step_blocks", k0)
+        _MP.incr("step_bytes", int(sum(true_ns)))
+        return MeshJob(k0=k0, cap=cap, Ls=Ls, Lb=Lb,
+                       b_small=self._b_small, true_ns=true_ns, cuts=cuts,
+                       counts=counts, digs=digs, hits=hits, _ev=ev)
+
+    def start_sha_many(self, job: MeshJob) -> None:
+        """API parity with ResidentReducer — the mesh step already
+        enqueued everything; nothing is awaited until finish_many."""
+
+    def finish_many(self, job: MeshJob) -> list[tuple]:
+        cuts = _fetch_global(job.cuts)
+        counts = _fetch_global(job.counts)
+        digs = _fetch_global(job.digs)
+        hits = _fetch_global(job.hits)
+        _ledger.readback(job._ev,
+                         d2h_bytes=cuts.nbytes + counts.nbytes
+                         + digs.nbytes + hits.nbytes)
+        job._ev = None
+        job.cuts = job.counts = job.digs = job.hits = None
+        Kl = counts.shape[0] // self.ndata
+        out = []
+        hit_lanes = 0
+        for b in range(job.k0):
+            if job.true_ns[b] == 0:
+                out.append((np.empty(0, np.uint64),
+                            np.empty((0, 32), np.uint8), frozenset()))
+                continue
+            nc = int(counts[b])
+            assert nc <= job.cap, "cut capacity proof violated"
+            c = cuts[b, :nc].astype(np.int64)
+            assert nc > 0 and c[-1] == job.true_ns[b], \
+                "device cut select lost the final cut"
+            starts = np.concatenate([[0], c[:-1]])
+            lens = c - starts
+            small = (lens + 9 + 63) // 64 <= job.b_small
+            rank = np.where(small, np.cumsum(small) - 1,
+                            np.cumsum(~small) - 1)
+            d, kl = b // Kl, b % Kl
+            base = d * Kl * (job.Ls + job.Lb)
+            rows = np.where(small, base + kl * job.Ls + rank,
+                            base + Kl * job.Ls + kl * job.Lb + rank)
+            dg = digs[rows]
+            hit = hits[rows] > 0
+            hit_lanes += int(hit.sum())
+            probe = frozenset(dg[i].tobytes()
+                              for i in np.nonzero(hit)[0])
+            out.append((c.astype(np.uint64), dg, probe))
+        if hit_lanes:
+            _MP.incr("probe_hit_lanes", hit_lanes)
+        return out
+
+    def reduce_many(self, datas: list) -> list[tuple]:
+        """Convenience serial driver (benchmarks, tests): groups of up to
+        max_group blocks, one mesh step each."""
+        out = []
+        g = self.max_group()
+        for at in range(0, len(datas), g):
+            out.extend(self.finish_many(self.submit_many(datas[at:at + g])))
+        return out
+
+
+# --------------------------------------------------------------------------
+# Sharded LZ4 match scan: the compress leg of the mesh plane.  Per-block
+# match scans are embarrassingly parallel, so the group spreads over 'data'
+# and the packed record rows come back in one readback — the same
+# (jobs, recs, ev) contract as TpuLz4.submit_many's batched branch, so
+# TpuLz4.finish_many assembles (and rescans/falls back) unchanged.
+# --------------------------------------------------------------------------
+
+_lz4_mesh_fns = _LruJitCache()
+
+
+def _lz4_scan_fn(mesh: Mesh, Kl: int, n_pad: int, stride: int,
+                 min_len: int, p1: int, p2: int, p3: int):
+    from hdrf_tpu.ops.lz4_tpu import _match_scan_impl
+
+    key = (mesh, Kl, n_pad, stride, min_len, p1, p2, p3)
+    fn = _lz4_mesh_fns.get(key)
+    if fn is not None:
+        return fn
+
+    def scan(blocks):
+        return jnp.stack([_match_scan_impl(blocks[i], stride, min_len,
+                                           p1, p2, p3)
+                          for i in range(Kl)])
+
+    fn = jax.jit(_shard_map(
+        scan, mesh=mesh, in_specs=(P("data", None),),
+        out_specs=P("data", None), check_rep=False), donate_argnums=(0,))
+    _lz4_mesh_fns.put(key, fn)
+    return fn
+
+
+def lz4_submit_many_sharded(lz, datas: list, mesh: Mesh):
+    """Submit a container group's LZ4 match scans as ONE mesh dispatch.
+
+    Blocks pad to one shape (the pad region's records are masked by the
+    emit's MFLIMIT cut, same as TpuLz4's device_images branch) and the
+    group pads to mesh width with dummy zero blocks.  Returns the
+    ``(jobs, recs, ev)`` triple ``lz.finish_many`` expects, or None when
+    the group doesn't fit the mesh (caller falls back to the single-device
+    path).  Each job keeps its padded HOST block so the overflow rescan
+    path still works."""
+    from hdrf_tpu.ops.lz4_tpu import _S, Lz4Job
+
+    if mesh.shape["seq"] != 1:
+        return None
+    ndata = mesh.shape["data"]
+    arrs = [np.frombuffer(d, dtype=np.uint8)
+            if not isinstance(d, np.ndarray) else d for d in datas]
+    if len(arrs) < 2 or min(a.size for a in arrs) < lz.min_device:
+        return None
+    n_max = max(a.size for a in arrs)
+    n_pad = n_max + (-n_max) % _S
+    k0 = len(arrs)
+    k = k0 + (-k0) % ndata
+    Kl = k // ndata
+    buf = np.zeros((k, n_pad), dtype=np.uint8)
+    for i, a in enumerate(arrs):
+        buf[i, :a.size] = a
+    p1, p2, p3 = lz._shapes(n_pad)
+    fn = _lz4_scan_fn(mesh, Kl, n_pad, lz.stride, lz.min_len, p1, p2, p3)
+    blocks = _put_global(buf, NamedSharding(mesh, P("data", None)))
+    ev = _ledger.dispatch("sharded.lz4", batch=k0, h2d_bytes=buf.nbytes,
+                          key=(Kl, n_pad, p1, p2, p3))
+    recs = fn(blocks)
+    recs.copy_to_host_async()
+    _MP.incr("lz4_steps")
+    jobs = [Lz4Job(n=a.size, host=a, block=buf[i], recs=None,
+                   p1=p1, p2=p2, p3=p3)
+            for i, a in enumerate(arrs)]
+    return jobs, recs, ev
+
+
+def lz4_compress_many_sharded(lz, datas: list, mesh: Mesh) -> list[bytes]:
+    sub = lz4_submit_many_sharded(lz, datas, mesh)
+    if sub is None:
+        return lz.compress_many(datas)
+    return lz.finish_many(sub)

@@ -81,14 +81,19 @@ def _append_new(containers, data, first_range: dict, new_hashes: list,
 
 def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
                  digests: np.ndarray, index, containers,
-                 on_seal=None) -> tuple[int, int]:
+                 on_seal=None, probe=None) -> tuple[int, int]:
     """The host half of the write pipeline, given device/native reduction
     results: ordered hash list, first-occurrence ranges, index lookup,
     container append of unique bytes, single-record index commit
     (DataDeduplicator.java checkChunk :338-367 + storeChunksMT :511-532 +
     storeDB :372-392).  Shared by DedupScheme.reduce and the full-path
-    benchmark so the timed path IS the product path.  Returns
-    (chunk_count, new_unique_count, new_unique_bytes)."""
+    benchmark so the timed path IS the product path.  ``probe`` (a set of
+    fingerprints the mesh plane's device bucket table flagged as
+    possibly-known) narrows the host index walk to probe POSITIVES: a
+    stale-table false positive is resolved right here by the authoritative
+    lookup, a false negative just re-appends bytes that ``commit_block``'s
+    first-commit-wins rule turns into compactable orphans — never
+    corruption.  Returns (chunk_count, new_unique_count, new_unique_bytes)."""
     with profiler.phase("dedup_lookup"):
         mv, hashes, first_range = _block_prep(data, cuts, digests)
         n = len(cuts)
@@ -98,16 +103,29 @@ def dedup_commit(block_id: int, data: bytes, cuts: np.ndarray,
             # one — CDC makes the rewrite dedup against its own old chunks,
             # so the released refs are mostly re-taken by the commit below.
             index.delete_block(block_id)
-        known = index.lookup_chunks(list(first_range))
-    new_hashes = [h for h, loc in known.items() if loc is None]
+        if probe is None:
+            known = index.lookup_chunks(list(first_range))
+            new_hashes = [h for h, loc in known.items() if loc is None]
+        else:
+            cand = [h for h in first_range if h in probe]
+            _M.incr("probe_skipped_lookups", len(first_range) - len(cand))
+            known = index.lookup_chunks(cand)
+            confirmed = sum(1 for loc in known.values() if loc is not None)
+            _M.incr("probe_confirmed", confirmed)
+            _M.incr("probe_false_positive", len(cand) - confirmed)
+            new_hashes = [h for h in first_range if known.get(h) is None]
     with profiler.phase("container_io"):
         # ordering probe: tests park block K here and assert block K+1's
         # device dispatch is already enqueued (pipeline overlap contract)
         fault_injection.point("dedup.container_append", block_id=block_id)
         locs = _append_new(containers, data, first_range, new_hashes,
                            on_seal or index.seal_container)
-    index.commit_block(block_id, len(data), hashes,
-                       dict(zip(new_hashes, locs)))
+    losers = index.commit_block(block_id, len(data), hashes,
+                                dict(zip(new_hashes, locs)))
+    if probe is not None and losers:
+        # stale-table false negatives that raced a concurrent first commit:
+        # their container bytes are orphans (reclaimed by compaction)
+        _M.incr("probe_stale_appends", len(losers))
     _M.incr("chunks_total", n)
     _M.incr("chunks_new", len(new_hashes))
     new_bytes = sum(ln for _, _, ln in locs)
@@ -252,13 +270,15 @@ class DedupScheme(ReductionScheme):
         return b""  # replica data file stays empty by design
 
     def reduce_with(self, block_id: int, data: bytes, cuts, digests,
-                    ctx: ReductionContext) -> bytes:
+                    ctx: ReductionContext, probe=None) -> bytes:
         """Commit with PRECOMPUTED device results — the streaming worker
         path: the DN already forwarded the packet stream to the worker and
-        holds (cuts, digests)."""
+        holds (cuts, digests) and, from the mesh plane, the on-device
+        dedup-probe verdict set."""
         assert ctx.index is not None and ctx.containers is not None
         _, _, new_bytes = dedup_commit(block_id, data, cuts, digests,
-                                       ctx.index, ctx.containers)
+                                       ctx.index, ctx.containers,
+                                       probe=probe)
         _M.incr("blocks_reduced")
         _M.incr("bytes_logical", len(data))
         accounting.record_reduce(self.name, len(data), new_bytes)

@@ -455,8 +455,14 @@ class BlockReceiver:
             # records "device_wait" at its final drain, and the in-process
             # jax path is attributed by the device ledger
             if precomputed is not None:
-                stored = scheme.reduce_with(block_id, data, *precomputed,
-                                            dn.reduction_ctx)
+                # (cuts, digests) from the worker/pipeline path; the mesh
+                # plane adds a third element — the on-device dedup-probe
+                # verdict set that lets dedup_commit skip the host index
+                # walk for probe-negative chunks.
+                cuts, digs, *rest = precomputed
+                stored = scheme.reduce_with(block_id, data, cuts, digs,
+                                            dn.reduction_ctx,
+                                            probe=rest[0] if rest else None)
             else:
                 stored = scheme.reduce(block_id, data, dn.reduction_ctx)
         with profiler.phase("container_io"):

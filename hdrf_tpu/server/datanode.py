@@ -248,12 +248,25 @@ class DataNode:
         # device batches + overlap scheduling when depth > 1; None keeps
         # the one-block-at-a-time serial path exactly as before.
         self.write_pipeline = None
+        # Mesh-sharded reduction plane (parallel/sharded.py): flips the
+        # dispatch-layer routing (batched lz4 seals included) and arms the
+        # coalescer's MeshReducer below.
+        ops_dispatch.set_mesh_plane(red.mesh_plane)
         if red.pipeline_depth > 1:
             from hdrf_tpu.server.write_pipeline import WritePipeline
 
             self.write_pipeline = WritePipeline(
                 red.cdc, backend, depth=red.pipeline_depth,
-                max_inflight=red.pipeline_max_inflight)
+                max_inflight=red.pipeline_max_inflight,
+                mesh_plane=red.mesh_plane,
+                mesh_lanes=red.mesh_lanes_per_device,
+                mesh_bucket_slots=red.mesh_bucket_slots)
+            if self.write_pipeline.mesh_reducer is not None:
+                # the device bucket table tracks the authoritative index
+                # incrementally: every commit's first-seen fingerprints
+                # flow into the next mesh step's refresh dispatch
+                self.index.add_commit_listener(
+                    self.write_pipeline.mesh_reducer.table.note_new)
             # seal compression off the commit critical path too: an
             # unlucky rollover must not stall the blocks queued behind it
             self.containers.enable_async_seals()

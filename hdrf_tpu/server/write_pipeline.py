@@ -25,6 +25,14 @@ serial path at 0.0% overlap efficiency):
   inline on the calling connection thread via ops/dispatch.py:105
   ``chunk_and_fingerprint`` — bit-identical results, today's serial
   behavior, no extra thread hops.
+- With ``ReductionConfig.mesh_plane`` on and >1 device attached, the
+  coalescer instead drives parallel/sharded.MeshReducer: the whole group
+  runs CDC+SHA+dedup-probe as ONE dispatch per mesh step, blocks
+  data-parallel across the mesh, and futures resolve
+  ``(cuts, digests, probe)`` 3-tuples whose probe set lets dedup_commit
+  skip the per-chunk host index walk for probe-negative chunks.
+  Mixed-size groups bucket-pad to the next lane size (``_pad_bucket``);
+  the padding waste is exported as ``coalesce_pad_bytes``.
 
 Each group's enqueue→finish window is recorded as a ``device_wait`` span
 into EVERY member block's timeline (utils/profiler.py BlockTimeline), so
@@ -64,10 +72,24 @@ class WritePipeline:
     """Admission + device-batch coalescing for concurrent block writes."""
 
     def __init__(self, cdc, backend: str, depth: int = 4,
-                 max_inflight: int = 8):
+                 max_inflight: int = 8, mesh_plane: bool = False,
+                 mesh_lanes: int = 2, mesh_bucket_slots: int = 1 << 15):
         self._cdc = cdc
         self._backend = backend
         self._depth = max(depth, 1)
+        # Mesh-sharded reduction plane (ReductionConfig.mesh_plane): one
+        # dispatch per mesh step for the whole coalesced group, dedup probe
+        # answered on-mesh.  Futures then resolve (cuts, digests, probe)
+        # 3-tuples; None (and 2-tuples) below 2 devices or when disabled.
+        self.mesh_reducer = None
+        if backend == "tpu" and mesh_plane:
+            self.mesh_reducer = dispatch.mesh_reducer(
+                cdc, lanes_per_device=mesh_lanes,
+                bucket_slots=mesh_bucket_slots)
+            if self.mesh_reducer is not None:
+                # fill the mesh: a step has ndata*lanes lanes, so the
+                # coalescer must be allowed to drain at least that many
+                self._depth = max(self._depth, self.mesh_reducer.max_group())
         self._sem = threading.BoundedSemaphore(max(max_inflight, 1))
         self._q: queue.Queue = queue.Queue()
         self._thread: threading.Thread | None = None
@@ -145,7 +167,9 @@ class WritePipeline:
         return items, False
 
     def _coalesce_loop(self) -> None:
-        r = self._reducer()
+        # The mesh plane supersedes the single-device reducer when present:
+        # same submit/start/finish protocol, one dispatch per mesh step.
+        r = self.mesh_reducer or self._reducer()
         # (BatchJob, members): submitted (enqueued) but not yet finished
         inflight: deque = deque()
         stopping = False
@@ -164,6 +188,9 @@ class WritePipeline:
                         continue
                     _M.incr("device_batches")
                     _M.observe("device_batch_blocks", len(group))
+                    if self.mesh_reducer is not None:
+                        _M.incr("mesh_batches")
+                        _M.observe("mesh_batch_blocks", len(group))
                     inflight.append((bj, group))
             if not inflight:
                 if stopping:
@@ -198,14 +225,34 @@ class WritePipeline:
                     tl.ledger_ids.extend(new_ids)
                 it.future.set_result(res)
 
+    @staticmethod
+    def _pad_bucket(n: int) -> int:
+        """Lane-size bucket for mixed-size coalescing: members of one
+        bucket share a device program padded to the longest member, so
+        near-sized blocks from different streams batch together instead of
+        each drawing its own dispatch (ROADMAP item 1 remainder).
+        Geometric 1/8-of-pow2 steps bound worst-case padding at ~12.5%."""
+        if n <= 4096:
+            return 4096
+        top = 1 << (n - 1).bit_length()
+        step = max(top // 8, 4096)
+        return -(-n // step) * step
+
     def _group(self, r, items: list[_Item]) -> list[list[_Item]]:
-        """Equal-length groups bounded by the reducer's max_group."""
-        by_len: dict[int, list[_Item]] = {}
+        """Lane-size-bucketed groups bounded by the reducer's max_group;
+        padding waste is surfaced as ``coalesce_pad_bytes``."""
+        by_bucket: dict[int, list[_Item]] = {}
         for it in items:
-            by_len.setdefault(it.arr.size, []).append(it)
+            by_bucket.setdefault(self._pad_bucket(it.arr.size),
+                                 []).append(it)
         groups: list[list[_Item]] = []
-        for size, members in by_len.items():
-            g = max(1, min(self._depth, r.max_group(size)))
+        for bucket, members in by_bucket.items():
+            g = max(1, min(self._depth, r.max_group(bucket)))
             for at in range(0, len(members), g):
-                groups.append(members[at:at + g])
+                grp = members[at:at + g]
+                gmax = max(it.arr.size for it in grp)
+                pad = sum(gmax - it.arr.size for it in grp)
+                if pad:
+                    _M.incr("coalesce_pad_bytes", pad)
+                groups.append(grp)
         return groups

@@ -443,7 +443,13 @@ class ContainerStore:
         with self._cache_lock:
             if cid in self._cache:
                 _M.incr("cache_hit")
-                return self._cache[cid]
+                # true LRU: re-insert on hit so eviction drops the least
+                # RECENTLY used container, not the oldest insertion (FIFO
+                # evicted the hottest container under cyclic read sets)
+                data = self._cache.pop(cid)
+                self._cache[cid] = data
+                return data
+            _M.incr("cache_miss")
         for lane in self._lanes:
             with lane.lock:
                 if lane.container_id == cid and lane.image is not None:
@@ -479,9 +485,11 @@ class ContainerStore:
         data = codecs.decompress(codecs.CODEC_NAMES[codec_id],
                                  blob[_SEAL_HDR.size:], usize)
         with self._cache_lock:
+            self._cache.pop(cid, None)  # keep the re-insert most-recent
             self._cache[cid] = data
             while len(self._cache) > self._cache_cap:
                 self._cache.pop(next(iter(self._cache)))
+                _M.incr("cache_evict")
         return data
 
     def read_chunks(self, locs: list[tuple[int, int, int]]) -> list[bytes]:

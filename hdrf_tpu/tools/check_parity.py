@@ -146,16 +146,44 @@ def check_prom_metrics(root: str, arch_md: str | None = None) -> list[str]:
     return problems
 
 
+def check_bench_contract(root: str, bench_py: str | None = None,
+                         key: str = "multichip") -> list[str]:
+    """Fourth lint: bench.py's output contract.  The bench emits its one
+    JSON line from two branches (native CPU fallback and the TPU path);
+    a summary block added to only one silently vanishes from whichever
+    backend the driver happens to land on.  Assert the ``key`` appears as
+    a literal dict key in at least two ``json.dumps({...})`` calls."""
+    if bench_py is None:
+        bench_py = os.path.join(os.path.dirname(root), "bench.py")
+    if not os.path.isfile(bench_py):
+        return [f"bench contract: {bench_py} missing"]
+    tree = ast.parse(open(bench_py, encoding="utf-8").read(), bench_py)
+    hits = 0
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "dumps" and node.args
+                and isinstance(node.args[0], ast.Dict)):
+            keys = {k.value for k in node.args[0].keys
+                    if isinstance(k, ast.Constant)}
+            hits += key in keys
+    if hits < 2:
+        return [f"bench contract: '{key}' key present in {hits} of the "
+                f"expected 2+ json.dumps branches of bench.py"]
+    return []
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     root = argv[0] if argv else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     problems = (check(root) + check_fault_points(root)
-                + check_prom_metrics(root))
+                + check_prom_metrics(root) + check_bench_contract(root))
     for p in problems:
         print(p)
     print(f"{len(problems)} violation(s)" if problems
-          else "parity citations + fault-point coverage + metric docs: clean")
+          else "parity citations + fault-point coverage + metric docs + "
+               "bench contract: clean")
     return 1 if problems else 0
 
 

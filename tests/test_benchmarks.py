@@ -75,6 +75,25 @@ def test_cdc_harness_one_json_line():
     assert o["serial_awaited_boundaries"] == {"xla": 2, "fused": 1}
 
 
+def test_multichip_harness_one_json_line():
+    """`benchmarks multichip` contract: EXACTLY one JSON line — the
+    1/2/4/8-device service-rate curve, pinned bit-identical to the native
+    oracle before timing, with device-ledger evidence that every mesh
+    step was ONE dispatch (the ISSUE 9 acceptance shape).  Tiny corpus,
+    one repeat — this asserts the protocol and line shape, not the
+    scaling bar (PERF_NOTES round 13 carries the measured curve)."""
+    out = run(["multichip", "--blocks", "16", "--repeats", "1"])
+    assert len(out) == 1
+    (o,) = out
+    assert o["op"].startswith("multichip")
+    assert o["oracle_ok"] is True
+    assert o["one_dispatch_per_step"] is True
+    assert set(o["MBps"]) == {"1", "2", "4", "8"}
+    assert all(v > 0 for v in o["MBps"].values())
+    assert o["ratio_8v1"] > 0
+    assert o["steps"] == o["step_dispatches"]
+
+
 def test_sort_harness():
     out = run(["sort", "--tiles", "1", "--entries", "2048", "--inner", "2",
                "--repeats", "1"])

@@ -129,6 +129,19 @@ class TestParityCitations:
                     "outstanding_dispatches", "wal_queue_depth"):
             assert f"`{fam}`" in arch, f"{fam} missing from metrics table"
 
+    def test_bench_multichip_block_in_both_json_branches(self):
+        """Bench-contract lint as a tier-1 gate: bench.py prints its one
+        JSON line from two branches (native fallback and the TPU path), so
+        the multichip service-rate block must be a literal key in BOTH —
+        a block added to one branch silently vanishes on the other
+        backend."""
+        import hdrf_tpu
+        from hdrf_tpu.tools import check_parity
+
+        root = os.path.dirname(os.path.abspath(hdrf_tpu.__file__))
+        problems = check_parity.check_bench_contract(root)
+        assert not problems, "\n".join(problems)
+
 
 class TestOfflineViewers:
     def test_oiv_oev(self, cluster, tmp_path):
