@@ -303,6 +303,44 @@ def _ec_summary() -> dict:
     }
 
 
+def _mirror_summary() -> dict:
+    """Coded-mirror-plane stamp for the JSON line: a small in-process
+    k-of-n exercise through server/mirror_plane.py's segment codec —
+    encode one payload at k=2/m=1, drop a DATA segment (the case that
+    forces an RS decode), reassemble, assert bit-identity — timed into
+    the ``ack_us`` histogram so the quantiles are never empty, then the
+    process-wide ``mirror`` registry counters (this exercise plus any
+    product mirror activity in the run: hedges fired, parity bytes paid,
+    reconciliations of partial replicas)."""
+    import time as _time
+
+    from hdrf_tpu.server import mirror_plane
+    from hdrf_tpu.utils import metrics
+
+    k, m = 2, 1
+    rng = np.random.default_rng(17)
+    payload = rng.integers(0, 256, size=(1 << 20) + 7,
+                           dtype=np.uint8).tobytes()
+    t0 = _time.perf_counter()
+    segments, _seg_len = mirror_plane.encode_segments(payload, k, m)
+    survivors = {i: s for i, s in enumerate(segments) if i != 0}
+    assert mirror_plane.assemble_payload(survivors, k, m, len(payload)) \
+        == payload, "coded mirror assembly diverged from the payload"
+    reg = metrics.registry("mirror")
+    reg.observe("ack_us", (_time.perf_counter() - t0) * 1e6)
+    with reg._lock:
+        ack = reg._histograms.get("ack_us")
+        p50 = ack.quantile(0.50) if ack else 0.0
+        p95 = ack.quantile(0.95) if ack else 0.0
+    return {
+        "ack_p50_us": round(float(p50), 1),
+        "ack_p95_us": round(float(p95), 1),
+        "hedges_fired": reg.counter("hedges_fired"),
+        "parity_bytes": reg.counter("parity_bytes"),
+        "reconciliations": reg.counter("reconciliations"),
+    }
+
+
 def _multichip_summary() -> dict:
     """Mesh-plane service-rate stamp for the JSON line: the `benchmarks
     multichip` sub-harness (1/2/4/8-device curve, native-oracle pinned,
@@ -417,6 +455,7 @@ def main() -> None:
                 "stalls": led.get("stall_total", 0),
                 "resilience": _resilience_summary(),
                 "ec": _ec_summary(),
+                "mirror": _mirror_summary(),
                 "phase_profile": phase_profile,
                 "pipeline": _pipeline_summary(phase_profile),
                 "multichip": _multichip_summary(),
@@ -744,6 +783,7 @@ def main() -> None:
             "stalls": led.get("stall_total", 0),
             "resilience": _resilience_summary(),
             "ec": _ec_summary(),
+            "mirror": _mirror_summary(),
             "phase_profile": phase_profile,
             "pipeline": _pipeline_summary(phase_profile),
             "multichip": _multichip_summary(),

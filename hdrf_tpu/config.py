@@ -140,6 +140,22 @@ class ReductionConfig:
     # 2^15 slots = 256 KiB/device).  Collisions only cost a host re-check
     # or a duplicate append — never correctness.
     mesh_bucket_slots: int = 1 << 15
+    # Coded mirror plane (server/mirror_plane.py): number of RS parity
+    # segments cut over the reduced mirror payload.  0 = today's serial
+    # relay through targets[0] (byte-identical path); m > 0 splits the
+    # payload into k = n_targets - m data segments + m parity segments,
+    # fans the legs out concurrently, and acks once ANY k land — a dead
+    # or straggling mirror costs m/k extra bytes instead of a stall.
+    mirror_parity: int = 0
+    # Hedge trigger: parity legs launch when fewer than k data legs have
+    # landed after (rolling-window p95 per-peer leg latency) * this
+    # multiplier (the PR 3 peer windows feed the p95; no window data
+    # falls back to mirror_hedge_floor_s).
+    mirror_hedge_p95_mult: float = 3.0
+    # Hedge-delay floor/fallback in seconds: used when the peer latency
+    # windows have no samples yet, and as a lower bound so a cold window
+    # never hedges at ~0 s.
+    mirror_hedge_floor_s: float = 0.25
     cdc: CdcConfig = field(default_factory=CdcConfig)
 
 
@@ -210,6 +226,11 @@ class NameNodeConfig:
     ec_data_shards: int = 6
     ec_parity_shards: int = 3
     ec_demote_after_s: float = 0.0
+    # Partial-replica reconciliation (coded mirror plane): how long a
+    # scheduled upgrade re-push may stay in flight before the monitor
+    # re-schedules it (the pending_replication_timeout_s analog for the
+    # partial_replica -> full-replica lifecycle).
+    partial_reconcile_timeout_s: float = 15.0
 
 
 @dataclass

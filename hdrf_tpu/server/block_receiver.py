@@ -58,6 +58,17 @@ def _checksums(data: bytes, chunk: int) -> list[int]:
     return [int(c) for c in native.crc32c_chunks(data, chunk)]
 
 
+class MirrorLegFailed(IOError):
+    """A downstream mirror hop failed; ``dn_id`` names the ACTUAL broken
+    peer — propagated back through the per-hop status frame that rides
+    ahead of the fixed 9-byte ack — so the NN outlier feed never blames
+    ``targets[0]`` for a failure two relay hops down."""
+
+    def __init__(self, msg: str, dn_id: str | None = None):
+        super().__init__(msg)
+        self.dn_id = dn_id
+
+
 def _connect(addr: list | tuple, dn=None, block_id: int | None = None,
              token: dict | None = None) -> socket.socket:
     """Mirror-leg socket; encrypts when this DN is configured to (the
@@ -493,14 +504,35 @@ class BlockReceiver:
         status = dt.ACK_SUCCESS
         if targets:
             try:
-                self.push_reduced(block_id, gen_stamp, scheme_name, len(data),
-                                  stored, crcs, targets)
+                failed_dn = dn.mirror.push(block_id, gen_stamp, scheme_name,
+                                           len(data), stored, crcs, targets)
+                if failed_dn:
+                    # every leg we drove landed, but a deeper relay hop
+                    # broke: the per-hop status frame carried its dn_id up
+                    self._note_mirror_failure(
+                        self._target_named(targets, failed_dn), block_id,
+                        IOError("downstream relay leg failed"))
             except (OSError, ConnectionError, retry.DeadlineExceeded) as e:
                 # Mirror failed; local copy is durable — the NN's redundancy
                 # monitor re-replicates (§3.5).  Matches pipeline-recovery
                 # semantics: report success for the local replica.
-                self._note_mirror_failure(targets[0], block_id, e)
+                if not getattr(e, "already_attributed", False):
+                    self._note_mirror_failure(
+                        self._target_named(targets,
+                                           getattr(e, "dn_id", None)),
+                        block_id, e)
         return status
+
+    @staticmethod
+    def _target_named(targets: list, dn_id: str | None) -> dict:
+        """The target dict matching ``dn_id``; falls back to targets[0]
+        (a direct-leg failure carries no deeper attribution)."""
+        if dn_id:
+            for t in targets:
+                if t.get("dn_id") == dn_id:
+                    return t
+            return {"dn_id": dn_id}
+        return targets[0]
 
     def _note_mirror_failure(self, target: dict, block_id: int,
                              e: BaseException) -> None:
@@ -520,13 +552,18 @@ class BlockReceiver:
 
     def push_reduced(self, block_id: int, gen_stamp: int, scheme_name: str,
                      logical_len: int, stored: bytes, crcs: list[int],
-                     targets: list, throttler=None) -> None:
+                     targets: list, throttler=None) -> str | None:
         """Ship the reduced form to targets[0], which relays to the rest.
         Used by both pipeline mirroring and NN-commanded re-replication
         (transferBlock, DataNode.java:2361 — which the reference serves by
         reconstructing FULL bytes, §3.3 note).  ``throttler`` caps the
         send rate on background legs (balancer moves, re-replication —
-        DataTransferThrottler's role); client pipeline legs pass None."""
+        DataTransferThrottler's role); client pipeline legs pass None.
+
+        Returns the dn_id of a FAILED deeper relay hop when the local leg
+        succeeded anyway (propagated up through the per-hop status frame),
+        None when the whole chain landed; raises :class:`MirrorLegFailed`
+        carrying the broken hop's dn_id otherwise."""
         dn = self._dn
         scheme = dn.scheme(scheme_name)
         push_t0 = time.perf_counter()
@@ -561,10 +598,17 @@ class BlockReceiver:
                     for chunk in chunks:
                         if throttler is not None:
                             throttler.throttle(len(chunk))
+                        # the mid-chunk-delta crash window: a mirror dying
+                        # between packets of the delta stream
+                        fault_injection.point("block_receiver.mirror_push",
+                                              block_id=block_id,
+                                              seqno=seqno, dn_id=dn.dn_id,
+                                              peer=targets[0].get("dn_id"))
                         dt.write_packet(mirror, seqno, chunk)
                         sent_bytes += len(chunk)
                         seqno += 1
                     dt.write_packet(mirror, seqno, b"", last=True)
+                    hop = recv_frame(mirror)  # per-hop status frame
                     _, status = dt.read_ack(mirror)
                 else:
                     # direct/compress family: ship the stored bytes as-is
@@ -580,12 +624,17 @@ class BlockReceiver:
                                     throttle=throttler.throttle
                                     if throttler is not None else None)
                     sent_bytes = len(stored)
+                    hop = recv_frame(mirror)  # per-hop status frame
                     _, status = dt.read_ack(mirror)
+            failed_dn = hop.get("failed_dn") if isinstance(hop, dict) else None
             if status != dt.ACK_SUCCESS:
-                raise IOError(f"mirror returned status {status}")
+                raise MirrorLegFailed(
+                    f"mirror returned status {status}",
+                    dn_id=failed_dn or targets[0].get("dn_id"))
             self._note_peer(targets[0], time.perf_counter() - push_t0,
                             max(sent_bytes, 1))
             _M.incr("reduced_mirror_pushes")
+            return failed_dn
         finally:
             mirror.close()
 
@@ -608,6 +657,20 @@ class BlockReceiver:
     def _ingest_reduced_inner(self, sock, dn, block_id, gen_stamp, scheme_name,
                               logical_len, crcs, cchunk, hashes,
                               targets) -> None:
+        # ingest-entry crash window (the fault matrix kills the mirror
+        # right here, before any frame goes back upstream)
+        fault_injection.point("block_receiver.ingest_reduced",
+                              block_id=block_id, gen_stamp=gen_stamp,
+                              dn_id=dn.dn_id)
+        existing = dn.replicas.get_meta(block_id)
+        if existing is not None and existing.gen_stamp > gen_stamp:
+            # stale-generation push (a re-push raced a pipeline-recovery
+            # gen bump, updatePipeline/FSNamesystem.java analog): refuse
+            # before any container append — accepting would roll the
+            # replica back behind its recovered generation
+            _M.incr("stale_gen_rejected")
+            raise IOError(f"stale gen_stamp {gen_stamp} < "
+                          f"{existing.gen_stamp} for block {block_id}")
         stored = b""
         if hashes is not None:
             hashes = [bytes(h) for h in hashes]
@@ -615,6 +678,10 @@ class BlockReceiver:
             with profiler.phase("dedup_lookup"):
                 known = dn.index.lookup_chunks(uniq)
             need = [i for i, h in enumerate(uniq) if known[h] is None]
+            # torn need-frame window: the mirror dying mid-negotiation
+            # (upstream sees a half-written frame / reset socket)
+            fault_injection.point("block_receiver.need_frame",
+                                  block_id=block_id, dn_id=dn.dn_id)
             send_frame(sock, {"need": need})
             chunks = [data for _, data, last in profiler.timed_iter(
                 "recv", dt.iter_packets(sock)) if data]
@@ -652,12 +719,25 @@ class BlockReceiver:
         with profiler.phase("ack"):
             dn.notify_block_received(block_id, meta.logical_len,
                                      meta.gen_stamp)
+        # a full replica supersedes any coded segments held for the block
+        # (re-push upgrade path of the partial-replica lifecycle)
+        dn.mirror.on_full_replica(block_id)
         status = dt.ACK_SUCCESS
+        failed_dn = None
         if targets:  # relay down the chain
             try:
-                self.push_reduced(block_id, gen_stamp, scheme_name,
-                                  logical_len, stored, list(crcs), targets)
+                failed_dn = self.push_reduced(block_id, gen_stamp,
+                                              scheme_name, logical_len,
+                                              stored, list(crcs), targets)
             except (OSError, ConnectionError, retry.DeadlineExceeded) as e:
-                self._note_mirror_failure(targets[0], block_id, e)
+                failed_dn = getattr(e, "dn_id", None) \
+                    or targets[0].get("dn_id")
+                self._note_mirror_failure(
+                    self._target_named(targets, failed_dn), block_id, e)
         with profiler.phase("ack"):
+            # per-hop status frame ahead of the fixed 9-byte ack: carries
+            # the failing downstream dn_id so upstream hops (and
+            # ultimately the primary's outlier feed) blame the ACTUAL
+            # broken peer, not targets[0]
+            send_frame(sock, {"status": int(status), "failed_dn": failed_dn})
             dt.send_ack(sock, 0, status)

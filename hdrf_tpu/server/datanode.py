@@ -306,9 +306,14 @@ class DataNode:
         self.tokens = BlockTokenVerifier()
         self._receiver = BlockReceiver(self)
         self._sender = BlockSender(self)
+        # coded mirror plane (server/mirror_plane.py): k-of-n segment
+        # fan-out with hedged parity legs; mirror_parity=0 degrades to the
+        # serial push_reduced relay through this object unchanged
+        from hdrf_tpu.server.mirror_plane import MirrorPlane
+        self.mirror = MirrorPlane(self)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        self._ibr_queue: list[tuple[int, int, int, str | None]] = []
+        self._ibr_queue: list[tuple[int, int, int, str | None, bool]] = []
         self._ibr_event = threading.Event()
         # Slow-peer detection inputs (DataNodePeerMetrics analog): decayed
         # rolling window of normalized downstream-transfer latencies per
@@ -510,13 +515,16 @@ class DataNode:
 
     def notify_block_received(self, block_id: int, length: int,
                               gen_stamp: int = -1,
-                              storage_type: str | None = None) -> None:
+                              storage_type: str | None = None,
+                              partial: bool = False) -> None:
         """Incremental block report (IBR) on finalize: queued and delivered
         by a dedicated thread so an unreachable NN can never stall the write
         pipeline's ack (HDFS IBRs are asynchronous for the same reason);
         best-effort — the periodic full report reconciles anything missed.
         Carries the replica's gen stamp so the NN can fence a superseded
-        pipeline's late finalize."""
+        pipeline's late finalize.  ``partial=True`` registers a coded
+        mirror SEGMENT (server/mirror_plane.py): never a read location —
+        the NN's reconciliation monitor upgrades it in the background."""
         # a (re)finalized replica invalidates any pinned copy: append's
         # copy-on-append rewrites the same block id, and serving the stale
         # pinned bytes would lose the appended region
@@ -524,7 +532,8 @@ class DataNode:
         # ... and revokes outstanding short-circuit grants for the same
         # reason (a cached client fd still maps the superseded inode)
         self._sc.registry.revoke(block_id)
-        self._ibr_queue.append((block_id, length, gen_stamp, storage_type))
+        self._ibr_queue.append((block_id, length, gen_stamp, storage_type,
+                                partial))
         self._ibr_event.set()
 
     def _ibr_loop(self) -> None:
@@ -532,7 +541,8 @@ class DataNode:
             self._ibr_event.wait(timeout=0.5)
             self._ibr_event.clear()
             while self._ibr_queue:
-                block_id, length, gen_stamp, stype = self._ibr_queue.pop(0)
+                block_id, length, gen_stamp, stype, partial = \
+                    self._ibr_queue.pop(0)
                 for nn in self._nns:
                     # pool-partitioned like full reports: a foreign NS's
                     # NN would only bounce the IBR off its pool guard
@@ -542,7 +552,8 @@ class DataNode:
                     try:
                         nn.call("block_received", dn_id=self.dn_id,
                                 block_id=block_id, length=length,
-                                gen_stamp=gen_stamp, storage_type=stype)
+                                gen_stamp=gen_stamp, storage_type=stype,
+                                partial=partial)
                     except (OSError, ConnectionError):
                         _M.incr("ibr_failures")
 
@@ -635,6 +646,16 @@ class DataNode:
         elif op == "write_reduced":
             self.tokens.verify(fields.get("token"), fields["block_id"], "w")
             self._receiver.ingest_reduced(sock, fields)
+        elif op == "mirror_segment":
+            # coded mirror plane leg: one RS segment of the reduced
+            # payload (server/mirror_plane.py); write-gated like any
+            # other ingest
+            self.tokens.verify(fields.get("token"), fields["block_id"], "w")
+            self.mirror.serve_segment(sock, fields)
+        elif op == "mirror_segment_read":
+            # peer gather leg of a partial-replica assembly
+            self.tokens.verify(fields.get("token"), fields["block_id"], "r")
+            self.mirror.serve_segment_read(sock, fields)
         elif op == dt.READ_BLOCK:
             self.tokens.verify(fields.get("token"), fields["block_id"], "r")
             self._sender.serve_read(sock, fields)
@@ -862,6 +883,12 @@ class DataNode:
         return {d: [s["median"], s["count"]]
                 for d, s in self._peer_win.summaries().items()}
 
+    def peer_latency_summaries(self) -> dict:
+        """dn_id -> full rolling-window summary (median/mean/max/p95 s/MB)
+        — the coded mirror plane's hedge-deadline input (it scales the
+        p95 by mirror_hedge_p95_mult; utils/rollwin.py:58)."""
+        return self._peer_win.summaries()
+
     def _volume_report(self) -> dict:
         """vol_id -> health + IO summary, riding heartbeats (the
         VolumeFailureSummary + SlowDiskReports payload, folded into one)."""
@@ -920,6 +947,7 @@ class DataNode:
             "cache_used": self.cache.used(),
             "index": self.index.stats(),
             "ec": self.ec.report(),
+            "mirror": self.mirror.report(),
         }
 
     def _execute(self, cmd: dict) -> None:
@@ -941,6 +969,10 @@ class DataNode:
             self.ec.demote(cmd)
         elif cmd["cmd"] == "stripe_repair":
             self.ec.repair(cmd)
+        elif cmd["cmd"] == "mirror_assemble":
+            # no full replica survives: assemble one from any k coded
+            # segments gathered off peers (server/mirror_plane.py)
+            self.mirror.assemble(cmd["block_id"])
         elif cmd["cmd"] == "recover_block":
             self._recover_block(cmd)
         elif cmd["cmd"] == "cache":

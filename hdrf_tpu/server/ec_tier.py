@@ -182,21 +182,33 @@ class EcTier:
                 freed = dn.containers.drop_sealed_file(cid)
                 _M.incr("containers_demoted")
                 _M.incr("demote_bytes_freed", freed)
+                # the full manifest rides the report so the NN can journal
+                # it (editlog/fsimage durable): owner-loss repair needs a
+                # copy that survives this DN's WAL dying with this DN
                 done.append({"cid": cid, "holders": targets,
                              "logical": manifest["length"],
-                             "physical": (k + m) * manifest["stripe_len"]})
+                             "physical": (k + m) * manifest["stripe_len"],
+                             "manifest": manifest})
         if done:
             self._notify_nn(bid, done)
 
     def repair(self, cmd: dict) -> None:
         """NN ``stripe_repair``: re-decode the lost stripe indices from k
-        survivors and push them to replacement holders."""
+        survivors and push them to replacement holders.  The manifest comes
+        from this DN's WAL when it is the group's owner; after OWNER loss
+        the NN deputizes a surviving holder and hands down its journaled
+        manifest copy (``cmd["manifest"]``) — repaired stripes keep the
+        original owner's name so every holder's files stay findable."""
         dn = self._dn
         fault_injection.point("stripe.repair", dn_id=dn.dn_id)
         cid = int(cmd["cid"])
-        manifest = dn.index.stripe_manifest(cid)
+        # an NN-supplied manifest (owner-loss deputization) wins over the
+        # local WAL: cids are per-DN counters, so the deputy's OWN container
+        # of the same cid would shadow the dead owner's group otherwise
+        manifest = cmd.get("manifest") or dn.index.stripe_manifest(cid)
         if manifest is None:
             return
+        owner = manifest.get("owner", dn.dn_id)
         missing = [int(i) for i in cmd["missing"]]
         targets = [list(t) for t in cmd["targets"]]
         with retry.bind(retry.Deadline(_CMD_BUDGET_S)):
@@ -211,7 +223,7 @@ class EcTier:
             try:
                 for idx, tgt in zip(missing, targets):
                     self._place(tgt, cid, idx, decoded[idx],
-                                manifest["crcs"][idx])
+                                manifest["crcs"][idx], owner=owner)
                     holders[idx] = list(tgt)
                     _M.incr("repair_bytes", len(decoded[idx]))
             except (OSError, ConnectionError, IOError,
@@ -219,32 +231,43 @@ class EcTier:
                 _M.incr("repair_failures")
                 return
         manifest["holders"] = holders
-        dn.index.record_stripe(cid, manifest)
+        if owner == dn.dn_id:
+            # agents repairing a dead owner's group must NOT WAL the
+            # foreign manifest: cids are per-DN counters, so a local
+            # record would shadow this DN's own container of the same id
+            # — the NN's editlog copy stays the orphan group's home
+            dn.index.record_stripe(cid, manifest)
         _M.incr("stripes_repaired", len(missing))
         self._notify_nn(cmd.get("block_id"),
                         [{"cid": cid, "holders": holders,
                           "logical": manifest["length"],
                           "physical": (int(manifest["k"])
                                        + int(manifest["m"]))
-                          * manifest["stripe_len"]}])
+                          * manifest["stripe_len"],
+                          "manifest": manifest}],
+                        owner=owner)
 
     # ---------------------------------------------------------- plumbing
 
     def _place(self, target: list, cid: int, idx: int, data: bytes,
-               crc: int) -> None:
+               crc: int, owner: str | None = None) -> None:
         """Durably land one stripe on ``target`` (local fast path; peers
         via stripe_write with capped retries under the ambient deadline and
-        the background-transfer throttle)."""
+        the background-transfer throttle).  ``owner`` names the group the
+        stripe files belong to — repairs of a dead owner's group pass the
+        ORIGINAL owner so surviving holders' (owner, cid, idx) paths stay
+        coherent; demotion defaults to this DN."""
         dn = self._dn
+        owner = owner or dn.dn_id
         tgt_id, host, port = target[0], target[1], int(target[2])
         if tgt_id == dn.dn_id:
-            self.store.put_stripe(dn.dn_id, cid, idx, data, crc=crc)
+            self.store.put_stripe(owner, cid, idx, data, crc=crc)
             return
         dn.balance_throttler.throttle(len(data))
 
         def _push() -> None:
             resp = dn._peer_call((host, port), "stripe_write",
-                                 owner=dn.dn_id, cid=cid, idx=idx,
+                                 owner=owner, cid=cid, idx=idx,
                                  data=data, crc=crc)
             if not resp.get("ok"):
                 raise IOError(f"stripe_write {cid}/{idx} to {tgt_id}: "
@@ -291,16 +314,20 @@ class EcTier:
                 continue
         return got
 
-    def _notify_nn(self, block_id, containers: list[dict]) -> None:
+    def _notify_nn(self, block_id, containers: list[dict],
+                   owner: str | None = None) -> None:
         """Report new/updated stripe groups (and the demoted block) to the
         NameNodes; first accepting NN wins — the active applies it, a
-        standby refuses (same pattern as commit_block_sync)."""
+        standby refuses (same pattern as commit_block_sync).  ``owner``
+        keys the groups when a deputized agent reports a dead owner's
+        repair (defaults to the reporting DN)."""
         from hdrf_tpu.proto.rpc import RpcError
 
         for nn in self._dn._nns:
             try:
                 nn.call("stripe_complete", dn_id=self._dn.dn_id,
-                        block_id=block_id, containers=containers)
+                        block_id=block_id, containers=containers,
+                        owner=owner)
                 return
             except (OSError, ConnectionError, RpcError):
                 continue

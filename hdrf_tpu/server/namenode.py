@@ -306,6 +306,18 @@ class NameNode:
         self._stripe_groups: dict[tuple[str, int], dict] = {}
         self._pending_demote: dict[int, float] = {}       # bid -> deadline
         self._pending_stripe_repair: dict[tuple[str, int], float] = {}
+        # Stripe manifests journaled at demote/repair time (editlog +
+        # fsimage durable, unlike the soft _stripe_groups cache) so
+        # owner-loss repair can rebuild a container's stripes after the
+        # owner DN — and its WAL-durable chunk index — is gone for good.
+        self._stripe_manifests: dict[tuple[str, int], dict] = {}
+        # Coded mirror plane: blocks where some DN holds only a k-of-n
+        # SEGMENT of the reduced payload (server/mirror_plane.py), not a
+        # full replica.  bid -> dn_id -> first-seen monotonic time.  These
+        # never count toward info.locations; the reconciliation monitor
+        # upgrades them to full replicas in the background.
+        self._partial_replicas: dict[int, dict[str, float]] = {}
+        self._pending_partial: dict[int, float] = {}      # bid -> retry deadline
         # Snapshots: frozen subtree images per snapshottable dir
         # (namenode/snapshot analog; blocks are immutable once complete, so a
         # structural freeze IS a consistent point-in-time view).
@@ -502,6 +514,8 @@ class NameNode:
             "next_cache_id": self._next_cache_id,
             "dtokens": self._dtokens.snapshot(),
             "ec_demoted": sorted(self._ec_demoted),
+            "stripe_manifests": [[owner, cid, man] for (owner, cid), man
+                                 in sorted(self._stripe_manifests.items())],
         }
 
     def _restore(self, snap: dict) -> None:
@@ -550,6 +564,8 @@ class NameNode:
         if "dtokens" in snap:
             self._dtokens.restore(snap["dtokens"])
         self._ec_demoted = set(snap.get("ec_demoted", []))
+        self._stripe_manifests = {(owner, int(cid)): man for owner, cid, man
+                                  in snap.get("stripe_manifests", [])}
 
     def _apply(self, rec: list) -> None:
         """Apply one edit record (replay path and live path share this)."""
@@ -766,10 +782,20 @@ class NameNode:
                                       sp_q if sp_q >= 0 else old[1])
                 self._qusage[path] = None  # seed lazily
         elif op == "ec_demote":
-            # [op, block_id] — block's containers demoted to the EC stripe
-            # tier; from here the block wants ONE full replica (the stripe
-            # owner) and redundancy lives in the (k+m)/k stripes.
-            self._ec_demoted.add(rec[1])
+            # [op, block_id, owner_dn, manifests?] — block's containers
+            # demoted to the EC stripe tier; from here the block wants ONE
+            # full replica (the stripe owner) and redundancy lives in the
+            # (k+m)/k stripes.  Grown records carry the stripe manifests
+            # (cid -> {k, m, holders, crcs, ...}) so owner-loss repair can
+            # rebuild stripes after the owner DN's WAL-durable index is
+            # gone; block_id is None when a repair re-journals manifests
+            # for an already-demoted block.  Two-field seed records (no
+            # owner/manifests) still replay.
+            if rec[1] is not None:
+                self._ec_demoted.add(rec[1])
+            if len(rec) >= 4:
+                for cid_s, man in (rec[3] or {}).items():
+                    self._stripe_manifests[(rec[2], int(cid_s))] = man
 
     def _account(self, rec: list) -> None:
         """Keep cached quota usage in sync with an applied edit.  Cheap ops
@@ -2576,14 +2602,22 @@ class NameNode:
 
     def rpc_block_received(self, dn_id: str, block_id: int, length: int,
                            gen_stamp: int = -1,
-                           storage_type: str | None = None) -> bool:
+                           storage_type: str | None = None,
+                           partial: bool = False) -> bool:
         """Incremental block report on pipeline finalize (IBR analog).
 
         An IBR records the replica but never fixes a UC block's length:
         first-reporter-wins would let the file complete at whatever length
         that one replica has, violating the min-CRC-verified-prefix
         invariant lease recovery guarantees — only ``complete`` and
-        ``commit_block_sync`` resolve lengths."""
+        ``commit_block_sync`` resolve lengths.
+
+        ``partial=True`` reports a coded mirror SEGMENT (a k-of-n slice
+        of the reduced payload, server/mirror_plane.py), not a replica:
+        it is tracked in ``_partial_replicas`` — never ``info.locations``,
+        never ``info.reported`` (segment lengths would poison lease
+        recovery) — until the reconciliation monitor upgrades the holder
+        to a full replica and a normal IBR clears the partial entry."""
         with self._lock:
             if block_id >> 48 != self.config.block_pool_index:
                 return False   # another nameservice's pool (federation)
@@ -2591,6 +2625,13 @@ class NameNode:
             info = self._blocks.get(block_id)
             if dn is None:
                 return False
+            if partial:
+                if info is None:
+                    return False
+                self._partial_replicas.setdefault(block_id, {}).setdefault(
+                    dn_id, time.monotonic())
+                _M.incr("partial_replicas_reported")
+                return True
             if info is None:
                 if self.role == "standby":
                     # IBR raced ahead of the journal tail: queue it (the
@@ -2620,6 +2661,13 @@ class NameNode:
                 info.locations.discard(dn_id)
             else:
                 info.locations.add(dn_id)
+            pr = self._partial_replicas.get(block_id)
+            if pr is not None and pr.pop(dn_id, None) is not None:
+                # a segment holder finished reconciling into a full replica
+                _M.incr("partial_upgrades")
+                if not pr:
+                    self._partial_replicas.pop(block_id, None)
+                    self._pending_partial.pop(block_id, None)
             return True
 
     def _charge_alloc(self, path: str, bid: int, size: int) -> None:
@@ -2770,6 +2818,10 @@ class NameNode:
                 "striped_containers": ec_striped,
                 "stripe_logical_bytes": ec_logical,
                 "stripe_physical_bytes": ec_physical,
+                # coded mirror plane: segment holders awaiting upgrade to
+                # full replicas (the reconciliation monitor's backlog)
+                "partial_replicas": sum(
+                    len(v) for v in self._partial_replicas.values()),
                 "slow_peers": len(health["slow_peers"]),
                 "slow_volumes": len(health["slow_volumes"]),
                 "reduction_degraded": len(health["degraded_nodes"]),
@@ -2800,33 +2852,68 @@ class NameNode:
             self._pending_stripe_repair.pop(key, None)
 
     def rpc_stripe_complete(self, dn_id: str, block_id=None,
-                            containers: list | None = None) -> bool:
+                            containers: list | None = None,
+                            owner: str | None = None) -> bool:
         """Owner-DN report closing a stripe demotion (or refreshing holder
         maps after a repair): journal the block's demotion (``ec_demote``
         edit — from here the redundancy monitor wants ONE full replica),
         invalidate the other full replicas, and cache the stripe groups
-        for the repair scheduler.  First accepting NN wins — a standby
-        refuses, the same contract as commit_block_sync."""
+        for the repair scheduler.  ``owner`` keys the groups when a
+        deputized agent reports a dead owner's repair — the stripes (and
+        the group identity) keep the original owner's name.  First
+        accepting NN wins — a standby refuses, the same contract as
+        commit_block_sync."""
         with self._lock:
             if self.role != "active":
                 raise StandbyError("namenode is standby")
-            for c in containers or []:
-                key = (dn_id, int(c["cid"]))
-                self._stripe_groups[key] = {
-                    "holders": [list(h) for h in c["holders"]],
-                    "length": int(c.get("logical", 0)),
-                    "block_id": block_id}
-                self._pending_stripe_repair.pop(key, None)
+            own = owner or dn_id
+            # full stripe manifests riding the report become editlog/fsimage
+            # durable (owner-loss repair input — the owner's WAL copy dies
+            # with the owner); repairs re-journal so holders stay current.
+            # Journal BEFORE touching the soft group cache: if _log raises
+            # (safemode right after a restart, a standby demotion), a cache
+            # already showing the repaired holders would tell the repair
+            # monitor "missing = []" forever while the durable manifests
+            # still name the dead DNs — the report must fail atomically so
+            # the DN-side repair gets re-scheduled and re-reported.
+            manifests = {str(int(c["cid"])): c["manifest"]
+                         for c in containers or [] if c.get("manifest")}
+
+            def _cache_groups() -> None:
+                for c in containers or []:
+                    key = (own, int(c["cid"]))
+                    self._stripe_groups[key] = {
+                        "holders": [list(h) for h in c["holders"]],
+                        "length": int(c.get("logical", 0)),
+                        "block_id": block_id}
+                    self._pending_stripe_repair.pop(key, None)
+
             if block_id is None:
-                return True  # repair of an unmapped group: cache only
+                # repair of an unmapped group: re-journal + cache manifests
+                if manifests:
+                    self._log(["ec_demote", None, own, manifests])
+                    _M.incr("stripe_manifests_journaled")
+                _cache_groups()
+                return True
             bid = int(block_id)
-            self._pending_demote.pop(bid, None)
             info = self._blocks.get(bid)
             if info is None:
+                self._pending_demote.pop(bid, None)
                 return True
             if bid not in self._ec_demoted:
-                self._log(["ec_demote", bid])
+                self._log(["ec_demote", bid, own, manifests])
                 _M.incr("blocks_ec_demoted")
+                if manifests:
+                    _M.incr("stripe_manifests_journaled")
+            elif manifests:
+                self._log(["ec_demote", None, own, manifests])
+                _M.incr("stripe_manifests_journaled")
+            _cache_groups()
+            self._pending_demote.pop(bid, None)
+            if own != dn_id:
+                # deputized-agent report: the agent holds no full replica,
+                # so the single-holder invalidation below must not run
+                return True
             # the owner is now the single full-replica holder; the other
             # copies are excess (redundancy rides the stripes)
             for d in sorted(info.locations - {dn_id}):
@@ -3551,6 +3638,7 @@ class NameNode:
                 fault_injection.point("namenode.monitor_tick")
                 self._check_dead_nodes()
                 self._check_replication()
+                self._check_partial_replicas()
                 self._settle_moves()
                 self._check_cache()
                 self._recover_leases()
@@ -3642,6 +3730,51 @@ class NameNode:
             # cached for rpc_cluster_status: the dfshealth page must not
             # re-walk every block under the namesystem lock per page load
             self._under_replicated = under
+
+    def _check_partial_replicas(self) -> None:
+        """Reconciliation monitor for the coded mirror plane (alongside
+        ``_check_stripe_repair``): a DN holding only a k-of-n SEGMENT of a
+        block's reduced payload (server/mirror_plane.py) is upgraded to a
+        full replica in the background — a ``replicate`` re-push from any
+        live full-replica holder, or, when the write lost every full copy,
+        a ``mirror_assemble`` command telling one segment holder to gather
+        any k segments off its peers and decode.  The partial entry clears
+        when the holder's normal (non-partial) IBR lands."""
+        with self._lock:
+            now = time.monotonic()
+            for bid in list(self._partial_replicas):
+                holders = self._partial_replicas[bid]
+                for d in [d for d in holders if d not in self._datanodes]:
+                    del holders[d]   # holder died with its segment
+                info = self._blocks.get(bid)
+                if not holders or info is None:
+                    self._partial_replicas.pop(bid, None)
+                    self._pending_partial.pop(bid, None)
+                    continue
+                if self._pending_partial.get(bid, 0.0) > now:
+                    continue   # an upgrade is already in flight
+                live_full = sorted(d for d in info.locations
+                                   if d in self._datanodes)
+                if live_full:
+                    src = self._datanodes[live_full[0]]
+                    src.commands.append({
+                        "cmd": "replicate", "block_id": bid,
+                        "gen_stamp": info.gen_stamp,
+                        "targets": [{"dn_id": d,
+                                     "addr": list(self._datanodes[d].addr)}
+                                    for d in sorted(holders)]})
+                    _M.incr("partial_reconciliations_scheduled")
+                else:
+                    agent = self._datanodes[sorted(holders)[0]]
+                    agent.commands.append({"cmd": "mirror_assemble",
+                                           "block_id": bid})
+                    _M.incr("partial_assembles_scheduled")
+                self._pending_partial[bid] = (
+                    now + self.config.partial_reconcile_timeout_s)
+                # keep _check_replication from double-scheduling the same
+                # deficit while the reconciliation transfer is in flight
+                self._pending_repl[bid] = (
+                    now + self.config.pending_replication_timeout_s)
 
     def _prune_excess(self, info, counted: set[str], want: int) -> None:
         """Drop excess replicas (BlockManager.processExtraRedundancy /
@@ -3795,9 +3928,11 @@ class NameNode:
         """Background stripe-repair scheduler over the soft-state group
         cache: a stripe whose holder left the cluster is re-decoded by the
         group's owner DN (it holds the WAL-durable manifest) onto healthy
-        replacements.  Owner loss itself is not repairable here — the
-        manifest lives in the owner's chunk index, so the owner IS the
-        group (documented trade-off, ARCHITECTURE.md decision 9)."""
+        replacements.  Owner loss is repairable too, since the demote-time
+        ``ec_demote`` edits journal each group's full manifest: a surviving
+        holder is deputized as the repair agent and hands the NN's durable
+        manifest copy down with the ``stripe_repair`` command
+        (_schedule_owner_loss_repair)."""
         with self._lock:
             now = time.monotonic()
             dead_after = self.config.dead_node_interval_s
@@ -3805,7 +3940,10 @@ class NameNode:
                 owner = self._datanodes.get(owner_id)
                 if (owner is None
                         or now - owner.last_heartbeat >= dead_after):
-                    continue  # repair agency lives with the owner
+                    # owner (and its WAL manifest) is gone: fall back to
+                    # the editlog-durable manifest via a surviving holder
+                    self._schedule_owner_loss_repair(owner_id, cid, grp, now)
+                    continue
                 missing = []
                 for idx, h in enumerate(grp["holders"]):
                     d = self._datanodes.get(h[0])
@@ -3839,6 +3977,68 @@ class NameNode:
                 self._pending_stripe_repair[key] = (
                     now + self.config.pending_replication_timeout_s)
                 _M.incr("stripe_repairs_scheduled")
+            # orphaned groups: the durable manifests remember stripes whose
+            # owner died before (or across an NN restart, where the soft
+            # cache starts empty) — sweep them through the same scheduler
+            for (owner_id, cid), man in list(self._stripe_manifests.items()):
+                if (owner_id, cid) in self._stripe_groups:
+                    continue
+                owner = self._datanodes.get(owner_id)
+                if (owner is not None
+                        and now - owner.last_heartbeat < dead_after):
+                    continue  # live owner re-reports the group itself
+                self._schedule_owner_loss_repair(
+                    owner_id, cid,
+                    {"holders": [list(h) for h in man["holders"]],
+                     "block_id": None}, now)
+
+    def _schedule_owner_loss_repair(self, owner_id: str, cid: int,
+                                    grp: dict, now: float) -> None:
+        """Repair a stripe group whose OWNER (and therefore the WAL-durable
+        manifest) is gone: deputize the first surviving holder as the
+        repair agent and hand it the NN's journaled manifest copy with the
+        ``stripe_repair`` command.  Repaired stripes keep the dead owner's
+        name (ec_tier._place owner=), so the group stays addressable; the
+        NN's editlog manifest remains the orphan group's durable home.
+        Caller holds self._lock."""
+        man = self._stripe_manifests.get((owner_id, cid))
+        if man is None:
+            return   # pre-durability residual: nothing to repair from
+        key = (owner_id, cid)
+        if self._pending_stripe_repair.get(key, 0.0) > now:
+            return
+        dead_after = self.config.dead_node_interval_s
+        missing, agent = [], None
+        for idx, h in enumerate(grp["holders"]):
+            d = self._datanodes.get(h[0])
+            if d is None or now - d.last_heartbeat >= dead_after:
+                missing.append(idx)
+            elif agent is None:
+                agent = d
+        if agent is None:
+            return   # no surviving holder left to deputize: data loss
+        if not missing:
+            self._pending_stripe_repair.pop(key, None)
+            return   # every stripe survives; group is merely owner-less
+        survivors = {h[0] for i, h in enumerate(grp["holders"])
+                     if i not in missing}
+        base = self._ec_placement_pool(now)
+        pool = [d for d in base if d.dn_id not in survivors] or base
+        if not pool:
+            return
+        targets = [pool[i % len(pool)] for i in range(len(missing))]
+        agent.commands.append({
+            "cmd": "stripe_repair", "cid": cid,
+            "block_id": grp.get("block_id"),
+            "missing": missing,
+            "targets": [[t.dn_id, t.addr[0], t.addr[1]] for t in targets],
+            # stamp the group's owner into the handed-down manifest: the
+            # agent's gather/placement key stripes by (owner, cid, idx),
+            # and the agent's own dn_id must never leak in as the default
+            "manifest": dict(man, owner=owner_id)})
+        self._pending_stripe_repair[key] = (
+            now + self.config.pending_replication_timeout_s)
+        _M.incr("owner_loss_repairs_scheduled")
 
     def _recover_leases(self) -> None:
         with self._lock:

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import queue
 import random
 import threading
 import time
@@ -177,6 +178,101 @@ def call_with_retries(fn: Callable[[], Any], attempts: int = 3,
             if delay > 0:
                 sleep(delay)
     raise last  # type: ignore[misc]
+
+
+class QuorumFailed(IOError):
+    """A hedged fan-out could not land its quorum: fewer than k legs
+    succeeded after every launched leg (primaries + hedges) resolved or
+    the overall budget expired.  ``errors`` holds (leg_index, exception)
+    pairs for per-leg attribution."""
+
+    def __init__(self, msg: str, errors: list | None = None):
+        super().__init__(msg)
+        self.errors = errors or []
+
+
+def hedged_quorum(primaries: list, hedges: list, k: int,
+                  hedge_after_s: float, timeout_s: float | None = None,
+                  on_hedge: Callable[[], None] | None = None,
+                  clock: Callable[[], float] = time.monotonic):
+    """Hedged-call fan-out with an any-k quorum ack (the coded mirror
+    plane's scheduling core; the "defer hedge until p95" discipline of
+    the tied-requests design the reference's pipeline lacks entirely —
+    SURVEY.md §0 fact 3, DataStreamer.java:765 forwards serially).
+
+    Launches every ``primaries`` thunk concurrently.  The ``hedges``
+    thunks launch when EITHER (a) any primary leg fails (fail-fast: a
+    dead peer or open breaker should not burn the hedge timer) or (b)
+    ``hedge_after_s`` elapses with fewer than k successes (straggler).
+    Returns ``(wins, errors, hedged)`` as soon as k legs succeed —
+    stragglers keep running on their daemon threads and resolve off the
+    caller's critical path.  ``wins``/``errors`` are (leg_index, payload)
+    pairs; hedge legs are indexed after the primaries.  Raises
+    :class:`QuorumFailed` when k successes become impossible, and honors
+    the ambient deadline through ``effective_budget``.
+    """
+    results: queue.Queue = queue.Queue()
+
+    def _run(idx: int, fn: Callable[[], Any]) -> None:
+        try:
+            results.put((idx, True, fn()))
+        except Exception as e:  # noqa: BLE001 — resolved at the quorum
+            results.put((idx, False, e))
+
+    for i, fn in enumerate(primaries):
+        threading.Thread(target=_run, args=(i, fn), daemon=True,
+                         name=f"hedge-leg-{i}").start()
+    total = len(primaries)
+    hedged = False
+
+    def _launch_hedges() -> None:
+        nonlocal total, hedged
+        if hedged or not hedges:
+            return
+        hedged = True
+        _M.incr("hedges_fired_total")
+        if on_hedge is not None:
+            on_hedge()
+        for j, fn in enumerate(hedges):
+            threading.Thread(target=_run, args=(len(primaries) + j, fn),
+                             daemon=True,
+                             name=f"hedge-leg-h{j}").start()
+        total += len(hedges)
+
+    overall = Deadline(effective_budget(
+        timeout_s if timeout_s is not None else 60.0), clock=clock)
+    hedge_at = clock() + max(0.0, float(hedge_after_s))
+    wins: list = []
+    errors: list = []
+    while len(wins) < k:
+        if len(wins) + len(errors) >= total:
+            if hedged or not hedges:
+                break  # every launched leg resolved; quorum unreachable
+            _launch_hedges()
+            continue
+        wait = overall.remaining()
+        if not hedged and hedges:
+            wait = min(wait, max(0.0, hedge_at - clock()))
+        try:
+            idx, ok, payload = results.get(timeout=max(wait, 0.001))
+        except queue.Empty:
+            if not hedged and hedges and clock() >= hedge_at:
+                _launch_hedges()
+                continue
+            if overall.expired:
+                break
+            continue
+        if ok:
+            wins.append((idx, payload))
+        else:
+            errors.append((idx, payload))
+            _launch_hedges()  # fail-fast: don't wait out the timer
+    if len(wins) < k:
+        _M.incr("quorum_failures_total")
+        raise QuorumFailed(
+            f"hedged quorum missed: {len(wins)}/{k} legs landed "
+            f"({len(errors)} failed)", errors)
+    return wins, errors, hedged
 
 
 class BreakerOpen(IOError):

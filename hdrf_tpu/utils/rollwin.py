@@ -56,14 +56,21 @@ class RollingWindow:
         return [v for _, v in self._samples]
 
     def summary(self, now: float | None = None) -> dict | None:
-        """{"median","mean","max","count"} over live samples, or None when
-        every sample has decayed out."""
+        """{"median","mean","max","p95","count"} over live samples, or None
+        when every sample has decayed out.  p95 is the nearest-rank upper
+        quantile — the hedged mirror legs' trigger statistic (a pure
+        function of the surviving samples, so deterministic under an
+        injected clock like the rest of the summary)."""
         vs = self.values(now)
         if not vs:
             return None
+        ranked = sorted(vs)
+        # nearest-rank: ceil(0.95 * n) - 1, clamped to the last sample
+        p95 = ranked[min(len(ranked) - 1, max(0, -(-len(ranked) * 95 // 100) - 1))]
         return {"median": statistics.median(vs),
                 "mean": sum(vs) / len(vs),
                 "max": max(vs),
+                "p95": p95,
                 "count": len(vs)}
 
 

@@ -348,3 +348,95 @@ class TestColdTierCluster:
             assert 'hdrf_stripes_encoded_total{registry="ec"}' in prom
         finally:
             gw.stop()
+
+
+# ------------------------------------------- owner-loss stripe durability
+
+
+class TestOwnerLossDurability:
+    def test_kill_owner_deputizes_survivor_from_journaled_manifest(
+            self, cold_cluster):
+        """Satellite: the demote-time ``ec_demote`` edit journals each
+        group's FULL stripe manifest into the NN editlog/fsimage, so a
+        dead owner DN (whose WAL held the only other copy) no longer
+        strands its groups: the repair monitor deputizes a surviving
+        holder, hands the journaled manifest down with ``stripe_repair``,
+        and the repaired stripes keep the dead owner's name."""
+        from hdrf_tpu.utils import metrics as _m
+
+        _NN = _m.registry("namenode")
+        mc = cold_cluster
+        rng = np.random.default_rng(29)
+        data = rng.integers(0, 256, size=200_000, dtype=np.uint8).tobytes()
+        with mc.client("ol") as c:
+            c.write("/cold/ol", data, scheme="dedup_lz4")
+            before_journal = _NN.counter("stripe_manifests_journaled")
+            mc.namenode.config.ec_demote_after_s = 0.3
+            time.sleep(0.3)
+            _wait(lambda: c._call("ec_status")["demoted_blocks"] >= 1,
+                  msg="block demotion")
+            _wait(lambda: _NN.counter("stripe_manifests_journaled")
+                  > before_journal, msg="manifest journaling")
+        assert mc.namenode._stripe_manifests, \
+            "demotion journaled no manifest into the NN"
+        owner = _owner_dn(mc)
+        assert owner is not None
+        owner_id = owner.dn_id
+        assert any(o == owner_id for o, _cid in mc.namenode._stripe_manifests)
+
+        # the manifests must survive an NN restart (editlog/fsimage replay
+        # of the grown ec_demote record) — the owner's WAL copy is NOT the
+        # durable home anymore
+        mc.restart_namenode()
+        mc.wait_for_datanodes(5)
+        assert mc.namenode._stripe_manifests, \
+            "journaled manifests lost across NN restart"
+        mc.namenode.config.ec_demote_after_s = 0.0
+        # the re-registration window right after the restart can fire
+        # spurious repairs (holders look dead until their first heartbeat
+        # lands); shrink the pending backoff so the REAL repair below is
+        # not throttled behind them
+        mc.namenode.config.pending_replication_timeout_s = 2.0
+        # startup safemode refuses edits — including the deputy's manifest
+        # re-journaling — so it must lift (the demoted block's owner
+        # replica reported back) BEFORE the kills take that replica away
+        # for good
+        with mc.client("olsm") as c:
+            _wait(lambda: not c._call("cluster_status")["safemode"],
+                  msg="post-restart safemode exit")
+
+        # kill -9 the owner (its WAL manifests die with it), then one
+        # stripe holder: without the journaled manifest this group would
+        # now be stranded — no owner to consult, a stripe gone.  The
+        # repair monitor must deputize a SURVIVING holder and hand the
+        # NN's manifest copy down with the stripe_repair command.
+        repair_agents = []
+        fault_injection.install(
+            "stripe.repair",
+            lambda dn_id=None, **kw: repair_agents.append(dn_id))
+        mc.kill_datanode(int(owner_id.split("-")[1]))
+        mans = [m for (o, _cid), m in mc.namenode._stripe_manifests.items()
+                if o == owner_id]
+        victim = next(h[0] for m in mans for h in m["holders"]
+                      if h[0] != owner_id)
+        n_pre = len(repair_agents)
+        before_sched = _NN.counter("owner_loss_repairs_scheduled")
+        before_rep = _EC.counter("stripes_repaired")
+        mc.kill_datanode(int(victim.split("-")[1]))
+        _wait(lambda: _NN.counter("owner_loss_repairs_scheduled")
+              > before_sched, timeout=25.0, msg="owner-loss scheduling")
+        _wait(lambda: _EC.counter("stripes_repaired") > before_rep,
+              timeout=25.0, msg="deputized stripe repair")
+        post = repair_agents[n_pre:]
+        assert post and all(a != owner_id for a in post), \
+            "repair ran on the dead owner instead of a deputy"
+
+        # the re-journaled manifests keep the dead owner's name as the
+        # group key while every holder entry points at a LIVE DN again
+        def _healed():
+            live = {dn.dn_id for dn in mc.datanodes if dn is not None}
+            mans = [m for (o, _cid), m in
+                    mc.namenode._stripe_manifests.items() if o == owner_id]
+            return mans and all(h[0] in live
+                                for m in mans for h in m["holders"])
+        _wait(_healed, timeout=30.0, msg="manifest holder re-registration")

@@ -44,7 +44,8 @@ class TestRollingWindow:
         w.add(3.0)
         t[0] = 5.0
         s = w.summary()
-        assert s == {"median": 2.0, "mean": 2.0, "max": 3.0, "count": 2}
+        assert s == {"median": 2.0, "mean": 2.0, "max": 3.0, "p95": 3.0,
+                     "count": 2}
         t[0] = 11.0  # both samples older than the window
         assert w.summary() is None
 

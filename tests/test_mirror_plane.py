@@ -1,0 +1,321 @@
+"""Coded mirror plane: k-of-n reduced mirroring with hedged legs
+(server/mirror_plane.py), so a dead or straggling mirror never stalls
+a write.
+
+Covers the fan-out scheduling and reconciliation semantics the serial
+relay chain of the reference lacks (DataStreamer.java:765 forwards hop
+by hop; BlockReceiver.java:635-641 fate-shares the ack with the
+slowest mirror) re-expressed as RS-coded segments (ops/rs.py:181
+Cauchy bit-matmul) with tied-request hedging (utils/retry.py:194
+hedged_quorum, per-peer p95 windows of utils/rollwin.py:58):
+
+- segment codec bit-identity vs the GF log/antilog host oracle
+  (ops/rs.py:134 encode_ref), any-k-survivors reassembly, padding
+  edges;
+- the acceptance matrix: one mirror killed mid-write (fault point
+  "mirror_plane.leg") — the ack lands without eating the leg timeout,
+  the hedged parity leg covers the dead peer, and the NN
+  reconciliation monitor (_check_partial_replicas) upgrades the
+  partial replica to a full one afterwards;
+- segment-ingest failure on the mirror side ("mirror_plane.segment")
+  hedging across to parity;
+- ``mirror_parity = 0`` staying on the serial relay verbatim (no coded
+  counters move);
+- the serial relay's own crash windows: a mirror dying mid-chunk-delta
+  ("block_receiver.mirror_push"), a torn need-frame negotiation
+  ("block_receiver.need_frame"), and a stale-generation re-push
+  refused at ingest entry ("block_receiver.ingest_reduced",
+  FSNamesystem updatePipeline analog) — each attributed to the ACTUAL
+  broken peer for the NN outlier feed, never ``targets[0]``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+import numpy as np
+import pytest
+
+from hdrf_tpu.ops import rs
+from hdrf_tpu.server import mirror_plane
+from hdrf_tpu.testing.minicluster import MiniCluster
+from hdrf_tpu.utils import fault_injection, metrics, retry
+
+RNG = np.random.default_rng(41)
+
+_MIR = metrics.registry("mirror")
+_BR = metrics.registry("block_receiver")
+_NN = metrics.registry("namenode")
+
+
+def _bytes(n):
+    return RNG.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+class Boom(Exception):
+    pass
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    retry.reset_breakers()
+    fault_injection.clear()
+    yield
+    retry.reset_breakers()
+    fault_injection.clear()
+
+
+def _wait(pred, timeout=25.0, interval=0.1, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ----------------------------------------------------------- segment codec
+
+
+class TestSegmentCodec:
+    K, M = 3, 2
+
+    def test_parity_matches_reference_encoder(self):
+        payload = _bytes(self.K * 1024 + 17)
+        segs, seg_len = mirror_plane.encode_segments(payload, self.K, self.M)
+        assert len(segs) == self.K + self.M
+        assert all(len(s) == seg_len for s in segs)
+        padded = payload.ljust(self.K * seg_len, b"\0")
+        data = np.frombuffer(padded, dtype=np.uint8).reshape(self.K, seg_len)
+        ref = rs.encode_ref(data, self.M)
+        for i in range(self.M):
+            assert segs[self.K + i] == ref[i].tobytes()
+
+    def test_any_k_survivors_reassemble(self):
+        payload = _bytes(100_000 + 13)
+        segs, _ = mirror_plane.encode_segments(payload, self.K, self.M)
+        for live in itertools.combinations(range(self.K + self.M), self.K):
+            got = mirror_plane.assemble_payload(
+                {i: segs[i] for i in live}, self.K, self.M, len(payload))
+            assert got == payload, f"survivor set {live} failed"
+
+    def test_padding_edges(self):
+        for n in (0, 1, self.K - 1, self.K, self.K + 1, 4096):
+            payload = _bytes(n)
+            segs, seg_len = mirror_plane.encode_segments(
+                payload, self.K, self.M)
+            assert seg_len >= 1  # zero-length frames never hit the wire
+            # drop all-but-one data segment: decode through parity
+            live = {0: segs[0]}
+            live.update({self.K + i: segs[self.K + i]
+                         for i in range(self.M)})
+            got = mirror_plane.assemble_payload(
+                dict(itertools.islice(live.items(), self.K)),
+                self.K, self.M, n)
+            assert got == payload
+
+    def test_fewer_than_k_segments_raises(self):
+        segs, _ = mirror_plane.encode_segments(_bytes(999), self.K, self.M)
+        with pytest.raises(ValueError):
+            mirror_plane.assemble_payload({0: segs[0]}, self.K, self.M, 999)
+
+    def test_bad_geometry_raises(self):
+        with pytest.raises(ValueError):
+            mirror_plane.encode_segments(b"x", 0, 1)
+
+
+# ------------------------------------------------------------- cluster e2e
+
+
+class TestCodedMirrorCluster:
+    def test_parity_zero_stays_on_serial_relay(self):
+        """mirror_parity=0 (the default) must be byte-identical to the
+        serial push_reduced path: no coded counters move, the replica
+        chain fills to the full replication factor."""
+        before_coded = _MIR.counter("coded_pushes")
+        before_segs = _MIR.counter("segments_sent")
+        with MiniCluster(n_datanodes=3, replication=3,
+                         block_size=1 << 20) as mc:
+            data = _bytes(300_000)
+            with mc.client("mp0") as c:
+                c.write("/mp0/f", data, scheme="dedup_lz4")
+                assert c.read("/mp0/f") == data
+            mc.wait_for_replication("/mp0/f", 3)
+        assert _MIR.counter("coded_pushes") == before_coded
+        assert _MIR.counter("segments_sent") == before_segs
+
+    def test_coded_push_registers_partials_then_reconciles(self):
+        """Happy path, mirror_parity=1 over a 2-target fan-out (k=1,
+        m=1): the ack needs ONE leg; the landed segment registers a
+        partial replica with the NN, and the reconciliation monitor
+        upgrades every partial to a full replica in the background."""
+        before_coded = _MIR.counter("coded_pushes")
+        before_partial = _NN.counter("partial_replicas_reported")
+        before_up = _NN.counter("partial_upgrades")
+        with MiniCluster(n_datanodes=3, replication=3, block_size=1 << 20,
+                         reduction_overrides={"mirror_parity": 1}) as mc:
+            data = _bytes(300_000)
+            with mc.client("mp1") as c:
+                c.write("/mp1/f", data, scheme="dedup_lz4")
+                assert c.read("/mp1/f") == data
+            assert _MIR.counter("coded_pushes") > before_coded
+            _wait(lambda: _NN.counter("partial_replicas_reported")
+                  > before_partial, msg="partial replica IBR")
+            mc.wait_for_replication("/mp1/f", 3)
+            _wait(lambda: _NN.counter("partial_upgrades") > before_up,
+                  msg="partial upgrade accounting")
+            # census drains once every partial went full
+            with mc.client("mp1c") as c:
+                _wait(lambda: c._call("cluster_status")
+                      ["partial_replicas"] == 0,
+                      msg="partial census drain")
+
+    def test_kill_one_mirror_mid_write_ack_lands_and_heals(self):
+        """The acceptance matrix: kill one mirror AS the coded fan-out
+        reaches it.  The dead data leg fails fast, the hedged parity leg
+        covers it, and the write acks without eating any leg timeout;
+        the NN reconciliation monitor then re-pushes until the block is
+        fully replicated on the survivors."""
+        before_hedges = _MIR.counter("hedges_fired")
+        before_coded = _MIR.counter("coded_pushes")
+        killed: list[str] = []
+        with MiniCluster(n_datanodes=3, replication=3, block_size=1 << 20,
+                         reduction_overrides={"mirror_parity": 1}) as mc:
+
+            def _kill_data_leg(peer=None, seg_index=None, **kw):
+                # first data leg (seg_index < k == 1): abrupt peer death
+                if seg_index == 0 and not killed and peer is not None:
+                    killed.append(peer)
+                    mc.kill_datanode(int(peer.split("-")[1]))
+
+            data = _bytes(300_000)
+            with fault_injection.inject("mirror_plane.leg", _kill_data_leg):
+                with mc.client("mpk") as c:
+                    t0 = time.monotonic()
+                    c.write("/mpk/f", data, scheme="dedup_lz4")
+                    elapsed = time.monotonic() - t0
+            assert killed, "fault point never saw the data leg"
+            # the whole point: a dead mirror must not stall the ack until
+            # the 60 s leg budget burns down
+            assert elapsed < 15.0, f"ack stalled {elapsed:.1f}s on dead leg"
+            assert _MIR.counter("hedges_fired") > before_hedges
+            assert _MIR.counter("coded_pushes") > before_coded
+            with mc.client("mpk2") as c:
+                assert c.read("/mpk/f") == data
+                # 2 live DNs left: the block must reach BOTH (head +
+                # the hedged survivor upgraded from its parity segment)
+                _wait(lambda: len(c._nn.call(
+                    "get_block_locations",
+                    path="/mpk/f")["blocks"][0]["locations"]) >= 2,
+                      msg="post-kill re-replication to the survivor")
+                assert c.read("/mpk/f") == data
+
+    def test_segment_ingest_failure_hedges_to_parity(self):
+        """A mirror that dies INSIDE segment ingest ("mirror_plane.segment"
+        window) answers with an error frame: the leg fails fast and the
+        parity hedge still lands the quorum."""
+        before_fail = _MIR.counter("segment_ingest_failures")
+        before_hedges = _MIR.counter("hedges_fired")
+        with MiniCluster(n_datanodes=3, replication=3, block_size=1 << 20,
+                         reduction_overrides={"mirror_parity": 1}) as mc:
+
+            def _boom_data_segment(seg_index=None, **kw):
+                if seg_index == 0:
+                    raise ValueError("injected segment ingest death")
+
+            data = _bytes(200_000)
+            with fault_injection.inject("mirror_plane.segment",
+                                        _boom_data_segment):
+                with mc.client("mps") as c:
+                    c.write("/mps/f", data, scheme="dedup_lz4")
+                    assert c.read("/mps/f") == data
+            assert _MIR.counter("segment_ingest_failures") > before_fail
+            assert _MIR.counter("hedges_fired") > before_hedges
+            mc.wait_for_replication("/mps/f", 3)
+
+
+# ------------------------------------------- serial relay crash windows
+
+
+class TestSerialRelayFaultMatrix:
+    def test_mirror_killed_mid_chunk_delta(self):
+        """"block_receiver.mirror_push": the mirror dies between packets
+        of the chunk-delta stream.  The primary's replica survives, the
+        write acks, and the ACTUAL peer is attributed."""
+        with MiniCluster(n_datanodes=2, replication=2,
+                         block_size=1 << 20) as mc:
+            data = _bytes(300_000)
+
+            def _die_mid_delta(seqno=None, **kw):
+                if seqno is not None and seqno >= 1:
+                    raise ConnectionError("injected mid-delta mirror death")
+
+            with fault_injection.inject("block_receiver.mirror_push",
+                                        _die_mid_delta):
+                with mc.client("md") as c:
+                    c.write("/md/f", data, scheme="dedup_lz4")
+                    assert c.read("/md/f") == data
+            flagged = {peer for dn in mc.datanodes if dn is not None
+                       for peer in dn._mirror_fail}
+            assert flagged, "mid-delta death never attributed"
+            live = {dn.dn_id for dn in mc.datanodes if dn is not None}
+            assert flagged <= live
+
+    def test_torn_need_frame(self):
+        """"block_receiver.need_frame": the mirror dies mid-negotiation,
+        before the need list goes back upstream — the primary sees a
+        reset socket, acks the client anyway, and attributes the peer."""
+        with MiniCluster(n_datanodes=2, replication=2,
+                         block_size=1 << 20) as mc:
+            data = _bytes(250_000)
+            with fault_injection.inject(
+                    "block_receiver.need_frame",
+                    lambda **kw: (_ for _ in ()).throw(
+                        ConnectionError("injected torn need frame"))):
+                with mc.client("tn") as c:
+                    c.write("/tn/f", data, scheme="dedup_lz4")
+                    assert c.read("/tn/f") == data
+            flagged = {peer for dn in mc.datanodes if dn is not None
+                       for peer in dn._mirror_fail}
+            assert flagged, "torn need frame never attributed"
+
+    def test_stale_gen_repush_rejected_at_ingest(self):
+        """A re-push carrying a STALE generation stamp must be refused at
+        ingest entry (the "block_receiver.ingest_reduced" window fires
+        first; accepting would roll the replica behind its recovered
+        generation) and accounted via ``stale_gen_rejected``."""
+        with MiniCluster(n_datanodes=2, replication=2,
+                         block_size=1 << 20) as mc:
+            data = _bytes(200_000)
+            with mc.client("sg") as c:
+                c.write("/sg/f", data, scheme="dedup_lz4")
+                loc = c._nn.call("get_block_locations",
+                                 path="/sg/f")["blocks"][0]
+            bid, gen = loc["block_id"], loc["gen_stamp"]
+            mc.wait_for_replication("/sg/f", 2)
+            pusher = next(dn for dn in mc.datanodes
+                          if dn is not None
+                          and dn.index.get_block(bid) is not None)
+            victim = next(dn for dn in mc.datanodes
+                          if dn is not None and dn is not pusher)
+            meta = victim.replicas.get_meta(bid)
+            assert meta is not None and meta.gen_stamp == gen
+            seen: list[tuple] = []
+            before = _BR.counter("stale_gen_rejected")
+            with fault_injection.inject(
+                    "block_receiver.ingest_reduced",
+                    lambda block_id=None, gen_stamp=None, **kw:
+                    seen.append((block_id, gen_stamp))):
+                with pytest.raises((IOError, ConnectionError)):
+                    pusher._receiver.push_reduced(
+                        bid, gen - 1, meta.scheme, meta.logical_len, b"",
+                        list(meta.checksums),
+                        [{"dn_id": victim.dn_id,
+                          "addr": list(victim.addr)}])
+            assert _BR.counter("stale_gen_rejected") == before + 1
+            assert (bid, gen - 1) in seen
+            # the stale push must not have rolled the replica back
+            assert victim.replicas.get_meta(bid).gen_stamp == gen
+            with mc.client("sg2") as c:
+                assert c.read("/sg/f") == data

@@ -142,6 +142,18 @@ class TestParityCitations:
         problems = check_parity.check_bench_contract(root)
         assert not problems, "\n".join(problems)
 
+    def test_bench_mirror_block_in_both_json_branches(self):
+        """Same contract for the coded mirror plane's summary block: the
+        hedge/ack numbers (server/mirror_plane.py) must ride BOTH
+        json.dumps branches of bench.py or the driver loses them on one
+        backend — and the output must stay exactly one JSON line."""
+        import hdrf_tpu
+        from hdrf_tpu.tools import check_parity
+
+        root = os.path.dirname(os.path.abspath(hdrf_tpu.__file__))
+        problems = check_parity.check_bench_contract(root, key="mirror")
+        assert not problems, "\n".join(problems)
+
 
 class TestOfflineViewers:
     def test_oiv_oev(self, cluster, tmp_path):
