@@ -40,8 +40,13 @@ Prints ONE JSON line:
                                                # profiler window over the
                                                # e2e passes (utils/profiler)
    "ec": {"stripes_encoded", "degraded_reads", "repair_bytes",
-          "storage_ratio"}}                    # EC cold-tier stamp
+          "storage_ratio"},                    # EC cold-tier stamp
                                                # (storage/stripe_store.py)
+   "read": {"read_amplification", "cache_hit_ratio", "read_p95_ms",
+            "tenant_count"}}                   # read-plane stamp over the
+                                               # product reconstruct path
+                                               # (HDRF_BENCH_READ_MOSTLY=1
+                                               # scales the replay rounds)
 """
 
 from __future__ import annotations
@@ -69,6 +74,14 @@ if os.environ.get("HDRF_BENCH_SMOKE") == "1":
     # and JSON contract, seconds instead of minutes (runs under XLA:CPU).
     BLOCK_MB, N_BLOCKS, SUB_BATCHES, CPU_MB = 1, 2, 2, 1
     E2E_BLOCKS = TG_BLOCKS = 2
+
+READ_ROUNDS = 3
+if os.environ.get("HDRF_BENCH_READ_MOSTLY") == "1":
+    # Read-mostly profile (same pattern as HDRF_BENCH_SMOKE): the read
+    # stamp replays its corpus many more times, so the cache-hit ratio and
+    # read-amplification numbers reflect a serving-heavy DataNode instead
+    # of a write-dominated one.
+    READ_ROUNDS = 16
 
 
 def _make_block(mb: int, seed: int) -> np.ndarray:
@@ -341,6 +354,77 @@ def _mirror_summary() -> dict:
     }
 
 
+def _read_summary(tmp: str) -> dict:
+    """Read-plane stamp for the JSON line: a small in-process exercise of
+    the PRODUCT read path — dedup-commit a tiny two-block corpus (one
+    block half-duplicating the other), seal it, then reconstruct every
+    block ``READ_ROUNDS`` times through DedupScheme.reconstruct under a
+    read timeline (utils/profiler.py read_timeline), so the same
+    index_lookup / container_decode phases, decoded-container LRU, and
+    read-amplification counters the DataNode serves /prom from are what
+    this stamp reports.  ``HDRF_BENCH_READ_MOSTLY=1`` raises the replay
+    count (read-mostly profile).  Keys: read_amplification (physical
+    decoded / logical served for the exercised scheme), cache_hit_ratio
+    (decoded-container LRU), read_p95_ms (read_wall_us histogram),
+    tenant_count (utils/tenants.py — the bench reads as its own tenant)."""
+    import time as _time
+
+    from hdrf_tpu import native
+    from hdrf_tpu.config import CdcConfig, ReductionConfig
+    from hdrf_tpu.index.chunk_index import ChunkIndex
+    from hdrf_tpu.ops.dispatch import gear_mask
+    from hdrf_tpu.reduction import accounting
+    from hdrf_tpu.reduction import scheme as schemes
+    from hdrf_tpu.reduction.dedup import dedup_commit
+    from hdrf_tpu.storage import container_store
+    from hdrf_tpu.storage.container_store import ContainerStore
+    from hdrf_tpu.utils import metrics, profiler, tenants
+
+    d = os.path.join(tmp, "readpath")
+    containers = ContainerStore(os.path.join(d, "containers"), codec="lz4")
+    index = ChunkIndex(os.path.join(d, "index"))
+    cdc = CdcConfig()
+    mask = gear_mask(cdc)
+    blocks = []
+    b0 = _make_block(1, seed=900)
+    blocks.append(b0.tobytes())
+    b1 = b0.copy()
+    b1[: b1.size // 2] = _make_block(1, seed=901)[: b1.size // 2]
+    blocks.append(b1.tobytes())
+    for bid, data in enumerate(blocks):
+        buf = np.frombuffer(data, np.uint8)
+        cuts = native.cdc_chunk(buf, mask, cdc.min_chunk, cdc.max_chunk)
+        starts = np.concatenate([[0], cuts[:-1]]).astype(np.uint64)
+        digs = native.sha256_batch(buf, starts,
+                                   (cuts - starts).astype(np.uint64))
+        dedup_commit(bid, data, cuts, digs, index, containers,
+                     on_seal=index.seal_container)
+    containers.flush_open(on_seal=index.seal_container)
+    scheme = schemes.get("dedup_lz4")
+    ctx = schemes.ReductionContext(config=ReductionConfig(),
+                                   containers=containers, index=index)
+    for _ in range(READ_ROUNDS):
+        for bid, data in enumerate(blocks):
+            t0 = _time.perf_counter()
+            with profiler.read_timeline(bid, nbytes=len(data)):
+                out = scheme.reconstruct(bid, b"", len(data), ctx)
+            assert out == data, "read-path stamp diverged from the corpus"
+            tenants.note_op("bench-reader", "read", len(data),
+                            latency_s=_time.perf_counter() - t0)
+    index.close()
+    amp = accounting.read_amplification_report().get(scheme.name, {})
+    reg = metrics.registry("read_profiler")
+    with reg._lock:
+        h = reg._histograms.get("read_wall_us")
+        p95 = h.quantile(0.95) if h else 0.0
+    return {
+        "read_amplification": round(amp.get("read_amplification", 0.0), 4),
+        "cache_hit_ratio": round(container_store.cache_hit_ratio(), 4),
+        "read_p95_ms": round(float(p95) / 1e3, 3),
+        "tenant_count": tenants.tenant_count(),
+    }
+
+
 def _multichip_summary() -> dict:
     """Mesh-plane service-rate stamp for the JSON line: the `benchmarks
     multichip` sub-harness (1/2/4/8-device curve, native-oracle pinned,
@@ -456,6 +540,7 @@ def main() -> None:
                 "resilience": _resilience_summary(),
                 "ec": _ec_summary(),
                 "mirror": _mirror_summary(),
+                "read": _read_summary(tmp),
                 "phase_profile": phase_profile,
                 "pipeline": _pipeline_summary(phase_profile),
                 "multichip": _multichip_summary(),
@@ -784,6 +869,7 @@ def main() -> None:
             "resilience": _resilience_summary(),
             "ec": _ec_summary(),
             "mirror": _mirror_summary(),
+            "read": _read_summary(tmp),
             "phase_profile": phase_profile,
             "pipeline": _pipeline_summary(phase_profile),
             "multichip": _multichip_summary(),

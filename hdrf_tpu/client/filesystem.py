@@ -84,6 +84,7 @@ class HdrfClient:
         if self._dtoken is not None:
             kw["_dtoken"] = self._dtoken
         kw["_user"] = self.user
+        kw["_client"] = self.name  # tenant attribution (utils/tenants.py)
         if self.groups:
             kw["_groups"] = self.groups
         for _ in range(16):
@@ -494,7 +495,8 @@ class HdrfClient:
             dt.send_op(sock, dt.WRITE_BLOCK, block_id=alloc["block_id"],
                        gen_stamp=alloc["gen_stamp"], scheme=alloc["scheme"],
                        token=alloc.get("token"), targets=targets[1:],
-                       storage_type=targets[0].get("storage_type"))
+                       storage_type=targets[0].get("storage_type"),
+                       _client=self.name)
             npkts = dt.stream_bytes(sock, block, self.config.packet_size)
             # Drain per-packet acks; the final one carries pipeline status.
             status = dt.ACK_SUCCESS
@@ -570,7 +572,8 @@ class HdrfClient:
                 if sc and loc["addr"][0] in ("127.0.0.1", "localhost"):
                     data = self._sc_cache.read(sc, binfo["block_id"], offset,
                                                length,
-                                               token=binfo.get("token"))
+                                               token=binfo.get("token"),
+                                               client_name=self.name)
                     if data is not None:
                         _M.incr("short_circuit_reads")
                         return data
@@ -698,7 +701,7 @@ class HdrfClient:
             sock = dt.secure_socket(sock, token,
                                     self.config.encrypt_data_transfer)
             dt.send_op(sock, dt.READ_BLOCK, block_id=block_id, offset=offset,
-                       length=length, token=token)
+                       length=length, token=token, _client=self.name)
             hdr = recv_frame(sock)
             if hdr["status"] != 0:
                 raise IOError(f"datanode error: {hdr['error']}: {hdr['message']}")
@@ -768,7 +771,8 @@ class HdrfOutputStream:
         dt.send_op(sock, dt.WRITE_BLOCK, block_id=alloc["block_id"],
                    gen_stamp=alloc["gen_stamp"], scheme="direct",
                    token=alloc.get("token"), targets=targets[1:],
-                   storage_type=targets[0].get("storage_type"))
+                   storage_type=targets[0].get("storage_type"),
+                   _client=self._c.name)
         self._sock, self._alloc, self._seqno = sock, alloc, 0
 
     def _teardown(self) -> None:

@@ -231,6 +231,12 @@ class NameNodeConfig:
     # re-schedules it (the pending_replication_timeout_s analog for the
     # partial_replica -> full-replica lifecycle).
     partial_reconcile_timeout_s: float = 15.0
+    # Flight recorder (utils/flight_recorder.py): fixed-cadence gauge
+    # snapshots into a bounded ring, served as /timeseries.  interval <= 0
+    # disables the sampler thread (the ring still answers, just empty
+    # until sample_once is driven).
+    flight_interval_s: float = 1.0
+    flight_capacity: int = 512
 
 
 @dataclass
@@ -293,6 +299,11 @@ class DataNodeConfig:
     # flags ops outliving this many seconds (the ~35 s VM write-burst
     # stalls, PERF_NOTES.md).
     stall_budget_s: float = 30.0
+    # Flight recorder (utils/flight_recorder.py): fixed-cadence gauge
+    # snapshots into a bounded ring, served as /timeseries.  interval <= 0
+    # disables the sampler thread.
+    flight_interval_s: float = 1.0
+    flight_capacity: int = 512
     reduction: ReductionConfig = field(default_factory=ReductionConfig)
 
 

@@ -21,11 +21,12 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any
 
 import msgpack
 
-from hdrf_tpu.utils import metrics, retry, tracing
+from hdrf_tpu.utils import metrics, retry, tenants, tracing
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
@@ -143,6 +144,9 @@ class RpcServer:
 
         _perm.set_caller(kwargs.pop("_user", None),
                          kwargs.pop("_groups", None))
+        # Tenant id for attribution only (utils/tenants.py) — stripped here
+        # like the rest of the side-channel so handlers never see it.
+        tenant = kwargs.pop("_client", None)
         fn = getattr(self._service, f"rpc_{method}", None)
         if fn is None:
             return [req_id, 1, {"error": "NoSuchMethod", "message": method}]
@@ -161,6 +165,7 @@ class RpcServer:
                 return [req_id, *cached]
         track = (self._watchdog.track(f"rpc.{method}")
                  if self._watchdog is not None else _null_ctx())
+        t_start = time.perf_counter()
         with retry.bind_remaining(deadline_hdr), track, \
                 self._tracer.span(method,
                                   parent=tuple(trace) if trace else None):
@@ -172,6 +177,9 @@ class RpcServer:
             except Exception as e:  # noqa: BLE001 — errors cross the wire
                 self._metrics.incr(f"{method}_errors")
                 out = [1, {"error": type(e).__name__, "message": str(e)}]
+        if tenant is not None:  # wire calls carrying a client id only
+            tenants.note_op(tenant, f"rpc.{method}",
+                            latency_s=time.perf_counter() - t_start)
         if retry_id is not None:
             self._retry_cache_put(retry_id, out)
         return [req_id, *out]

@@ -14,6 +14,12 @@ registry —
   ``dedup_chunks_miss`` + per-block histograms), fed by the same commit
   code dedup_commit / CommitPipeline already run
   (DataDeduplicator.java:338-367's checkChunk is the hit/miss point);
+- read-amplification accounting (``read_logical_bytes__<scheme>`` vs
+  ``read_physical_bytes__<scheme>`` vs ``read_stripe_bytes__<scheme>``):
+  logical bytes served, physical container bytes actually decoded, and
+  stripe bytes gathered for EC degraded reads — the serving-path mirror of
+  the reduce-side ratio (DataConstructor.java:430-567 re-decompresses whole
+  containers per read and never measures it);
 - refcount and container-utilization distributions, recomputed fresh from
   the chunk index's live tables (index/chunk_index.py:309-317's stats
   surface) by the DataNode's heartbeat assembly — state snapshots, not
@@ -32,9 +38,19 @@ touched from this module).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+
 from hdrf_tpu.utils import metrics
 
 _ACC = metrics.registry("reduction_accounting")
+
+# Ambient scheme tag for the READ side: the reconstruct entry point knows
+# which scheme is serving, but the physical decode happens layers below in
+# storage/container_store.py (which knows nothing about schemes) — the same
+# contextvar trick the profiler uses for its ambient timeline.
+_read_scheme: contextvars.ContextVar[str | None] = \
+    contextvars.ContextVar("hdrf_read_scheme", default=None)
 
 
 def record_reduce(scheme: str, logical_bytes: int,
@@ -77,9 +93,73 @@ def stripe_ratio(logical_bytes: int, physical_bytes: int) -> float:
     return (physical_bytes / logical_bytes) if logical_bytes else 0.0
 
 
+# ----------------------------------------------------- read amplification
+
+
+@contextlib.contextmanager
+def read_scope(scheme: str):
+    """Tag the ambient read with its serving scheme so the container
+    store's decode point (storage/container_store.py read_container) can
+    attribute physical decoded bytes per scheme without knowing schemes
+    exist."""
+    tok = _read_scheme.set(scheme)
+    try:
+        yield
+    finally:
+        _read_scheme.reset(tok)
+
+
+def record_read_logical(scheme: str, nbytes: int) -> None:
+    """Logical bytes served to a reader, per scheme (the denominator of
+    the read-amplification ratio)."""
+    _ACC.incr(f"read_logical_bytes__{scheme}", int(nbytes))
+
+
+def record_container_decode(nbytes: int) -> None:
+    """Physical container bytes DECODED to serve reads (cache hits decode
+    nothing — that is the compounding win ROADMAP item 1 chases).  Scheme
+    attribution comes from the ambient :func:`read_scope`; decodes outside
+    any read scope (compaction, EC repair) book under ``raw``."""
+    s = _read_scheme.get() or "raw"
+    _ACC.incr(f"read_physical_bytes__{s}", int(nbytes))
+
+
+def record_stripe_gather(nbytes: int) -> None:
+    """Stripe bytes gathered over the wire/disk for EC degraded reads —
+    the third rung of the amplification ladder (logical < decoded <
+    gathered when a read has to reassemble a demoted container)."""
+    s = _read_scheme.get() or "raw"
+    _ACC.incr(f"read_stripe_bytes__{s}", int(nbytes))
+
+
+def read_amplification_report() -> dict:
+    """Per-scheme read-amplification ratios recomputed from the cumulative
+    counters: ``physical / logical`` (and ``stripe / logical``) — 0.0 for
+    schemes that served nothing yet.  Refreshes matching gauges so the
+    ratios ride /prom next to the raw byte counters."""
+    snap = _ACC.snapshot()["counters"]
+    out: dict[str, dict] = {}
+    for key, v in snap.items():
+        if key.startswith("read_logical_bytes__"):
+            scheme = key[len("read_logical_bytes__"):]
+            logical = int(v)
+            physical = int(snap.get(f"read_physical_bytes__{scheme}", 0))
+            stripe = int(snap.get(f"read_stripe_bytes__{scheme}", 0))
+            amp = physical / logical if logical else 0.0
+            out[scheme] = {"logical_bytes": logical,
+                           "physical_bytes": physical,
+                           "stripe_bytes": stripe,
+                           "read_amplification": amp,
+                           "stripe_amplification":
+                               stripe / logical if logical else 0.0}
+            _ACC.gauge(f"read_amplification__{scheme}", amp)
+    return out
+
+
 def snapshot() -> dict:
     """The registry snapshot (rides DN heartbeats; also on /prom and
     /metrics through the process-wide exposition)."""
+    read_amplification_report()  # refresh the derived gauges first
     return _ACC.snapshot()
 
 

@@ -28,6 +28,7 @@ import os
 import socket
 import socketserver
 import threading
+import time
 import uuid
 from typing import Iterator
 
@@ -42,8 +43,9 @@ from hdrf_tpu.server.block_receiver import BlockReceiver
 from hdrf_tpu.server.block_sender import BlockSender
 from hdrf_tpu.server.status_http import StatusHttpServer
 from hdrf_tpu.reduction import accounting
-from hdrf_tpu.utils import (device_ledger, fault_injection, log, metrics,
-                            profiler, retry, rollwin, tracing)
+from hdrf_tpu.utils import (device_ledger, fault_injection, flight_recorder,
+                            log, metrics, profiler, retry, rollwin, tenants,
+                            tracing)
 from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("datanode")
@@ -367,11 +369,18 @@ class DataNode:
         self.watchdog = StallWatchdog(self.dn_id,
                                       budget_s=config.stall_budget_s,
                                       registry=_M)
+        # Flight recorder: over-time curve of this DN's key gauges,
+        # served as /timeseries (utils/flight_recorder.py).
+        self.flight = flight_recorder.FlightRecorder(
+            self.dn_id, self._flight_sample,
+            interval_s=config.flight_interval_s,
+            capacity=config.flight_capacity)
         self._status = None
         if config.status_port is not None:
             self._status = StatusHttpServer(self.dn_id, host=config.host,
                                             port=config.status_port,
-                                            watchdog=self.watchdog)
+                                            watchdog=self.watchdog,
+                                            recorder=self.flight)
         from hdrf_tpu.server.shortcircuit import ShortCircuitServer
         self._sc = ShortCircuitServer(
             self, os.path.join(config.data_dir, "sc.sock"))
@@ -390,6 +399,8 @@ class DataNode:
         self._threads.append(t)
         self._sc.start()
         self.watchdog.start()
+        if self.config.flight_interval_s > 0:
+            self.flight.start()
         if self._status is not None:
             self._status.start()
         self._register()
@@ -443,6 +454,7 @@ class DataNode:
     def stop(self) -> None:
         self._stop.set()
         self.watchdog.stop()
+        self.flight.stop()
         if self._status is not None:
             self._status.stop()
         self._sc.stop()
@@ -639,10 +651,16 @@ class DataNode:
         watchdog tracking and the exception accounting."""
         if op == dt.WRITE_BLOCK:
             self.tokens.verify(fields.get("token"), fields["block_id"], "w")
+            t_start = time.monotonic()
             if fields["scheme"] == "direct":
                 self._receiver.receive_direct(sock, fields)
             else:
                 self._receiver.receive_reduced(sock, fields)
+            if fields.get("_client"):
+                meta = self.replicas.get_meta(fields["block_id"])
+                tenants.note_op(fields["_client"], "write",
+                                meta.logical_len if meta else 0,
+                                latency_s=time.monotonic() - t_start)
         elif op == "write_reduced":
             self.tokens.verify(fields.get("token"), fields["block_id"], "w")
             self._receiver.ingest_reduced(sock, fields)
@@ -928,6 +946,60 @@ class DataNode:
             "counters": accounting.snapshot(),
         }
 
+    def _read_plane_report(self) -> dict:
+        """Serving-path aggregate riding heartbeats to /health: decoded-
+        container cache hit ratio, per-scheme read amplification, and the
+        per-tenant rolling SLO summaries (utils/tenants.py)."""
+        from hdrf_tpu.storage import container_store
+
+        return {
+            "container_cache_hit_ratio": container_store.cache_hit_ratio(),
+            "read_amplification": accounting.read_amplification_report(),
+            "tenants": tenants.summaries(),
+        }
+
+    @staticmethod
+    def _hist_quantile_ms(reg_name: str, key: str, q: float = 0.95) -> float:
+        """p-quantile (ms) of one registry histogram, 0.0 when absent."""
+        reg = metrics.registry(reg_name)
+        with reg._lock:
+            h = reg._histograms.get(key)
+            return (h.quantile(q) / 1e3) if h is not None else 0.0
+
+    def _flight_sample(self) -> dict:
+        """The flight recorder's gauge set — the ~dozen numbers whose
+        over-time curve is the honest production story (ROADMAP item 3):
+        storage/dedup ratios, cache hit rate, read/write p95, inflight
+        ops, breaker states."""
+        from hdrf_tpu.storage import container_store
+
+        acc = self.index.accounting()
+        logical = sum(m[2] for m in self.replicas.block_report())
+        physical = (self.replicas.physical_bytes()
+                    + self.containers.physical_bytes()
+                    + self.ec.store.physical_bytes())
+        brs = retry.all_breakers().values()
+        states = [b.state for b in brs]
+        with self._inflight_cv:
+            inflight = self._inflight
+        return {
+            "storage_ratio": (physical / logical) if logical else 0.0,
+            "dedup_ratio": accounting.dedup_ratio(
+                acc["logical_bytes"], acc["unique_chunk_bytes"]),
+            "container_cache_hit_ratio": container_store.cache_hit_ratio(),
+            "read_p95_ms": self._hist_quantile_ms("read_profiler",
+                                                  "read_wall_us"),
+            "write_p95_ms": self._hist_quantile_ms("write_profiler",
+                                                   "block_wall_us"),
+            "inflight": inflight,
+            "blocks": len(self.replicas.block_ids()),
+            "stalls": self.watchdog.stall_count(),
+            "breakers_open": sum(1 for s in states if s == "open"),
+            "breakers_half_open": sum(1 for s in states
+                                      if s == "half_open"),
+            "tenant_count": tenants.tenant_count(),
+        }
+
     def _stats(self) -> dict:
         with self._mirror_fail_lock:
             mirror_failures = dict(self._mirror_fail)
@@ -937,6 +1009,7 @@ class DataNode:
             "peer_transfer": self._peer_report(),
             "volumes": self._volume_report(),
             "reduction": self._reduction_report(),
+            "read_plane": self._read_plane_report(),
             "stalls": self.watchdog.stall_count(),
             "blocks": len(self.replicas.block_ids()),
             "logical_bytes": sum(m[2] for m in self.replicas.block_report()),

@@ -33,9 +33,10 @@ from __future__ import annotations
 
 import os
 
+from hdrf_tpu.reduction import accounting
 from hdrf_tpu.storage import stripe_store
 from hdrf_tpu.storage.container_store import _SEAL_HDR, _SEAL_MAGIC
-from hdrf_tpu.utils import fault_injection, metrics, retry
+from hdrf_tpu.utils import fault_injection, metrics, profiler, retry
 
 _M = metrics.registry("ec")
 
@@ -285,33 +286,35 @@ class EcTier:
         owner = manifest.get("owner", dn.dn_id)
         holders = manifest["holders"]
         got: dict[int, bytes] = {}
-        for idx in range(k + m):
-            if len(got) >= k:
-                break
-            if exclude and idx in exclude:
-                continue
-            tgt_id, host, port = (holders[idx][0], holders[idx][1],
-                                  int(holders[idx][2]))
-            if tgt_id == dn.dn_id:
-                try:
-                    got[idx] = self.store.read_stripe(owner, cid, idx)
-                except OSError:
+        with profiler.phase("ec_gather"):
+            for idx in range(k + m):
+                if len(got) >= k:
+                    break
+                if exclude and idx in exclude:
                     continue
-                continue
-            br = retry.breaker(f"{dn.dn_id}->{tgt_id}")
-            if not br.allow():
-                _M.incr("breaker_skips")
-                continue
-            try:
-                resp = dn._peer_call((host, port), "stripe_read",
-                                     owner=owner, cid=cid, idx=idx)
-                if not resp.get("ok"):
-                    raise IOError(resp.get("error", "stripe_read failed"))
-                got[idx] = resp["data"]
-                br.record_success()
-            except (OSError, ConnectionError, IOError, KeyError):
-                br.record_failure()
-                continue
+                tgt_id, host, port = (holders[idx][0], holders[idx][1],
+                                      int(holders[idx][2]))
+                if tgt_id == dn.dn_id:
+                    try:
+                        got[idx] = self.store.read_stripe(owner, cid, idx)
+                    except OSError:
+                        continue
+                    continue
+                br = retry.breaker(f"{dn.dn_id}->{tgt_id}")
+                if not br.allow():
+                    _M.incr("breaker_skips")
+                    continue
+                try:
+                    resp = dn._peer_call((host, port), "stripe_read",
+                                         owner=owner, cid=cid, idx=idx)
+                    if not resp.get("ok"):
+                        raise IOError(resp.get("error", "stripe_read failed"))
+                    got[idx] = resp["data"]
+                    br.record_success()
+                except (OSError, ConnectionError, IOError, KeyError):
+                    br.record_failure()
+                    continue
+        accounting.record_stripe_gather(sum(len(v) for v in got.values()))
         return got
 
     def _notify_nn(self, block_id, containers: list[dict],

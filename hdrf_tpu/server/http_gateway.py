@@ -32,6 +32,9 @@ protocols, with the reference's protocol shapes:
                  JSON with counter tracks) — the pull-model replacement for
                  the reference's HTrace span receivers;
   GET  /stacks   live thread stacks (HttpServer2 StackServlet analog);
+  GET  /timeseries  the NameNode flight recorder's bounded gauge ring
+                 (utils/flight_recorder.py; per-DN rings on each DN's own
+                 status endpoint);
   /dfshealth /datanode /journal /explorer  web UIs.
 """
 
@@ -153,6 +156,8 @@ class HttpGateway:
                         return self._json(200, out)
                     if u.path == "/stacks":
                         return self._json(200, gateway.stacks())
+                    if u.path == "/timeseries":
+                        return self._json(200, gateway.timeseries())
                     if not u.path.startswith(PREFIX):
                         return self._json(404, {"error": "not found"})
                     path = unquote(u.path[len(PREFIX):]) or "/"
@@ -569,6 +574,18 @@ class HttpGateway:
         from hdrf_tpu.utils.watchdog import thread_stacks
 
         return {"daemon": "http_gateway", "threads": thread_stacks()}
+
+    def timeseries(self) -> dict:
+        """The NameNode flight recorder's ring (flight_timeseries RPC;
+        per-DN rings live on each DN's own /timeseries status endpoint) —
+        the time-series the slo_report tool plots."""
+        try:
+            with HdrfClient(self._nn_addr, name="http-gw") as c:
+                return c._call("flight_timeseries")
+        except (OSError, ConnectionError):
+            _M.incr("timeseries_nn_unreachable")
+            return {"daemon": "namenode", "interval_s": 0.0, "capacity": 0,
+                    "samples": [], "error": "namenode unreachable"}
 
     # ------------------------------------------------------------- web UIs
 

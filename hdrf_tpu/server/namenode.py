@@ -34,7 +34,8 @@ from hdrf_tpu.proto.rpc import RpcError, RpcServer
 from hdrf_tpu.server import permissions as perm
 from hdrf_tpu.server.editlog import EditLog
 from hdrf_tpu.server.permissions import Attrs, DirNode
-from hdrf_tpu.utils import fault_injection, log, metrics, outlier, tracing
+from hdrf_tpu.utils import (fault_injection, flight_recorder, log, metrics,
+                            outlier, retry, tenants, tracing)
 from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("namenode")
@@ -379,6 +380,13 @@ class NameNode:
                                       registry=_M)
         self._rpc = RpcServer(self.config.host, self.config.port, self,
                               "namenode", watchdog=self.watchdog)
+        # Cluster-level flight recorder (utils/flight_recorder.py): exists
+        # even without a status port — the gateway pulls its ring over the
+        # flight_timeseries RPC.
+        self.flight = flight_recorder.FlightRecorder(
+            "namenode", self._flight_sample,
+            interval_s=self.config.flight_interval_s,
+            capacity=self.config.flight_capacity)
         self._status = None
         if self.config.status_port is not None:
             from hdrf_tpu.server.status_http import StatusHttpServer
@@ -386,7 +394,8 @@ class NameNode:
             self._status = StatusHttpServer("namenode",
                                             host=self.config.host,
                                             port=self.config.status_port,
-                                            watchdog=self.watchdog)
+                                            watchdog=self.watchdog,
+                                            recorder=self.flight)
         self._monitor_stop = threading.Event()
         self._monitor: threading.Thread | None = None
         self._logger = log.get_logger("namenode")
@@ -396,6 +405,8 @@ class NameNode:
     def start(self) -> "NameNode":
         self._rpc.start()
         self.watchdog.start()
+        if self.config.flight_interval_s > 0:
+            self.flight.start()
         if self._status is not None:
             self._status.start()
         target = (self._monitor_loop if self.role == "active"
@@ -410,6 +421,7 @@ class NameNode:
 
     def stop(self) -> None:
         self._monitor_stop.set()
+        self.flight.stop()
         self.watchdog.stop()
         if self._status is not None:
             self._status.stop()
@@ -3074,6 +3086,34 @@ class NameNode:
 
     def rpc_metrics(self) -> dict:
         return metrics.all_snapshots()
+
+    def _flight_sample(self) -> dict:
+        """Cluster-level flight-recorder gauges: namespace size, replication
+        backlogs, live DN population, safemode, per-tenant population and
+        breaker states — the numbers an operator plots first."""
+        with self._lock:
+            now = time.monotonic()
+            live = sum(1 for dn in self._datanodes.values()
+                       if now - dn.last_heartbeat
+                       < self.config.dead_node_interval_s)
+            sample = {
+                "blocks": len(self._blocks),
+                "datanodes": len(self._datanodes),
+                "datanodes_live": live,
+                "under_replicated": self._under_replicated,
+                "pending_replication": len(self._pending_repl),
+                "pending_recovery": len(self._pending_recovery),
+                "safemode": int(self._safemode_forced or self._safemode_auto),
+            }
+        states = [b.state for b in retry.all_breakers().values()]
+        sample["breakers_open"] = sum(1 for s in states if s == "open")
+        sample["tenant_count"] = tenants.tenant_count()
+        return sample
+
+    def rpc_flight_timeseries(self) -> dict:
+        """The NN flight recorder's bounded ring, for the gateway's
+        /timeseries endpoint (same pull model as rpc_trace_spans)."""
+        return self.flight.snapshot()
 
     def rpc_trace_spans(self) -> dict:
         """This process's finished spans + device-ledger events, for the

@@ -33,7 +33,7 @@ import socket
 import threading
 from typing import TYPE_CHECKING
 
-from hdrf_tpu.utils import metrics
+from hdrf_tpu.utils import metrics, profiler, tenants
 
 if TYPE_CHECKING:
     from hdrf_tpu.server.datanode import DataNode
@@ -289,45 +289,55 @@ class ShortCircuitServer:
                 payload = json.dumps({"status": "denied"}).encode()
                 conn.sendall(len(payload).to_bytes(4, "little") + payload)
                 return
-            meta = self._dn.replicas.get_meta(block_id)
-            if meta is None:
-                payload = json.dumps({"status": "no_block"}).encode()
-                conn.sendall(len(payload).to_bytes(4, "little") + payload)
-                return
-            resp = {"status": "ok", "scheme": meta.scheme,
-                    "logical_len": meta.logical_len,
-                    "physical_len": meta.physical_len,
-                    "checksum_chunk": meta.checksum_chunk,
-                    "checksums": meta.checksums,
-                    # never pass an fd for an in-flight (hflush-visible)
-                    # replica: its rbw file is still growing and the
-                    # granted checksums would go stale — network reads
-                    # serve the visible prefix instead
-                    "fd": (meta.scheme == "direct" and meta.physical_len > 0
-                           and not self._dn.replicas.is_rbw(block_id))}
-            if resp["fd"] and "shm_id" in req:
-                # revocable grant: the slot index + generation the client
-                # must check before every cached-fd read
-                g = self.registry.grant(int(req["shm_id"]), block_id)
-                if g is not None:
-                    resp["slot"], resp["slot_gen"] = g
-            # Length-prefixed reply: checksum lists for large blocks run to
-            # tens of KB, far past any single recv.  The fd rides the
-            # ancillary data of the 4-byte prefix send.
-            payload = json.dumps(resp).encode()
-            prefix = len(payload).to_bytes(4, "little")
-            if resp["fd"]:
-                fd = os.open(self._dn.replicas.data_path(block_id),
-                             os.O_RDONLY)
-                try:
-                    socket.send_fds(conn, [prefix], [fd])
-                finally:
-                    os.close(fd)  # receiver holds its own copy
-                conn.sendall(payload)
-                _M.incr("fds_passed")
-            else:
-                conn.sendall(prefix + payload)
-                _M.incr("metadata_only")
+            # The fd-grant serve is a (tiny) read too: its timeline rings
+            # beside the TCP serve_read ones so short-circuit latency is
+            # attributed on the same read families.
+            with profiler.read_timeline(block_id):
+                with profiler.phase("index_lookup"):
+                    meta = self._dn.replicas.get_meta(block_id)
+                if meta is None:
+                    payload = json.dumps({"status": "no_block"}).encode()
+                    conn.sendall(len(payload).to_bytes(4, "little") + payload)
+                    return
+                resp = {"status": "ok", "scheme": meta.scheme,
+                        "logical_len": meta.logical_len,
+                        "physical_len": meta.physical_len,
+                        "checksum_chunk": meta.checksum_chunk,
+                        "checksums": meta.checksums,
+                        # never pass an fd for an in-flight (hflush-visible)
+                        # replica: its rbw file is still growing and the
+                        # granted checksums would go stale — network reads
+                        # serve the visible prefix instead
+                        "fd": (meta.scheme == "direct"
+                               and meta.physical_len > 0
+                               and not self._dn.replicas.is_rbw(block_id))}
+                if resp["fd"] and "shm_id" in req:
+                    # revocable grant: the slot index + generation the client
+                    # must check before every cached-fd read
+                    g = self.registry.grant(int(req["shm_id"]), block_id)
+                    if g is not None:
+                        resp["slot"], resp["slot_gen"] = g
+                # Length-prefixed reply: checksum lists for large blocks run
+                # to tens of KB, far past any single recv.  The fd rides the
+                # ancillary data of the 4-byte prefix send.
+                payload = json.dumps(resp).encode()
+                prefix = len(payload).to_bytes(4, "little")
+                # Book the op BEFORE the reply hits the wire so a client
+                # that just read its payload observes the tenant counter.
+                tenants.note_op(req.get("_client"), "read_sc")
+                with profiler.phase("net_send"):
+                    if resp["fd"]:
+                        fd = os.open(self._dn.replicas.data_path(block_id),
+                                     os.O_RDONLY)
+                        try:
+                            socket.send_fds(conn, [prefix], [fd])
+                        finally:
+                            os.close(fd)  # receiver holds its own copy
+                        conn.sendall(payload)
+                        _M.incr("fds_passed")
+                    else:
+                        conn.sendall(prefix + payload)
+                        _M.incr("metadata_only")
         except (OSError, ValueError, KeyError):
             _M.incr("errors")
         finally:
@@ -465,7 +475,8 @@ class ShortCircuitCache:
                               "slot": ent[1], "gen": ent[2]})
 
     def read(self, sock_path: str, block_id: int, offset: int,
-             length: int, token: dict | None = None) -> bytes | None:
+             length: int, token: dict | None = None,
+             client_name: str | None = None) -> bytes | None:
         key = (sock_path, block_id)
         with self._lock:
             ent = self._fds.get(key)
@@ -489,6 +500,8 @@ class ShortCircuitCache:
                     return out
                 self._drop(key)  # stale/corrupt: refetch below
         req = {"block_id": block_id, "token": _entok(token)}
+        if client_name:
+            req["_client"] = client_name  # tenant attribution (utils/tenants.py)
         if shm_id is not None:
             req["shm_id"] = shm_id
         resp, fds, _ = _request(sock_path, req)
@@ -546,12 +559,15 @@ class ShortCircuitCache:
 
 
 def read_local(sock_path: str, block_id: int, offset: int,
-               length: int, token: dict | None = None) -> bytes | None:
+               length: int, token: dict | None = None,
+               client_name: str | None = None) -> bytes | None:
     """Uncached one-shot short-circuit read: fd fetched, pread, closed —
     no shm allocation (a throwaway segment per call would grow the DN's
     registry for nothing)."""
-    resp, fds, _ = _request(sock_path, {"block_id": block_id,
-                                        "token": _entok(token)})
+    req = {"block_id": block_id, "token": _entok(token)}
+    if client_name:
+        req["_client"] = client_name  # tenant attribution (utils/tenants.py)
+    resp, fds, _ = _request(sock_path, req)
     if not resp or resp.get("status") != "ok" or not resp.get("fd") \
             or not fds:
         for fd in fds:

@@ -13,7 +13,11 @@ opts into via ``status_port`` config, serving
   Perfetto JSON with counter tracks; the gateway's /traces merges these
   across daemons),
 - ``/stacks``  — live thread stacks plus the watchdog's recent stall
-  captures (the HttpServer2 StackServlet analog).
+  captures (the HttpServer2 StackServlet analog),
+- ``/timeseries`` — the daemon's flight-recorder ring (bounded over-time
+  gauge samples, utils/flight_recorder.py; nothing in the reference
+  serves a curve — MutableRollingAverages keeps a few windowed means and
+  discards the series).
 
 The server threads are daemonic and shut down with the owning daemon.
 """
@@ -31,9 +35,13 @@ from hdrf_tpu.utils.watchdog import StallWatchdog, thread_stacks
 
 class StatusHttpServer:
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
-                 watchdog: StallWatchdog | None = None):
+                 watchdog: StallWatchdog | None = None,
+                 recorder=None):
+        """``recorder``: optional utils.flight_recorder.FlightRecorder —
+        when set, ``/timeseries`` serves its bounded gauge ring."""
         self.name = name
         self._watchdog = watchdog
+        self._recorder = recorder
         status = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -73,6 +81,10 @@ class StatusHttpServer:
                     return self._send(200,
                                       json.dumps(status.stacks()).encode(),
                                       "application/json")
+                if u.path == "/timeseries":
+                    return self._send(200,
+                                      json.dumps(status.timeseries()).encode(),
+                                      "application/json")
                 self._send(404, b'{"error": "not found"}',
                            "application/json")
 
@@ -103,6 +115,15 @@ class StatusHttpServer:
             counters = []  # counter samples have no trace affinity
         return {"daemon": self.name, "spans": spans, "ledger": ledger,
                 "counters": counters}
+
+    def timeseries(self) -> dict:
+        """The flight recorder's ring (utils/flight_recorder.py), or an
+        empty shell when the daemon runs without a recorder — the endpoint
+        shape stays stable either way."""
+        if self._recorder is None:
+            return {"daemon": self.name, "interval_s": 0.0, "capacity": 0,
+                    "samples": []}
+        return self._recorder.snapshot()
 
     def stacks(self) -> dict:
         out = {"daemon": self.name, "threads": thread_stacks()}

@@ -34,6 +34,20 @@ from hdrf_tpu.utils import fault_injection, metrics
 
 _M = metrics.registry("container_store")
 
+
+def cache_hit_ratio() -> float:
+    """Decoded-container LRU hit ratio over the process's cumulative
+    ``cache_hit``/``cache_miss`` counters (0.0 before any probe) — the
+    /prom + /health gauge ROADMAP item 1 asks for (the counters existed
+    since the true-LRU landed but were never surfaced as a ratio)."""
+    hits, misses = _M.counter("cache_hit"), _M.counter("cache_miss")
+    total = hits + misses
+    return hits / total if total else 0.0
+
+
+def _gauge_hit_ratio() -> None:
+    _M.gauge("cache_hit_ratio", cache_hit_ratio())
+
 _SEAL_HDR = struct.Struct("<IQI")  # magic, usize, codec id
 _SEAL_MAGIC = 0x48435452  # "RTCH"
 # Open (.raw) containers carry a same-width placeholder header so sealing an
@@ -448,11 +462,16 @@ class ContainerStore:
                 # evicted the hottest container under cyclic read sets)
                 data = self._cache.pop(cid)
                 self._cache[cid] = data
+                _gauge_hit_ratio()
                 return data
             _M.incr("cache_miss")
+        _gauge_hit_ratio()
+        from hdrf_tpu.reduction import accounting  # storage->reduction: leaf-only
+
         for lane in self._lanes:
             with lane.lock:
                 if lane.container_id == cid and lane.image is not None:
+                    accounting.record_container_decode(len(lane.image))
                     return bytes(lane.image)  # open lane: serve from memory
         try:
             # Still-open container: read raw bytes directly
@@ -464,7 +483,9 @@ class ContainerStore:
                 magic = _SEAL_HDR.unpack(f.read(_SEAL_HDR.size))[0]
                 if magic != _RAW_MAGIC:
                     raise IOError(f"container {cid}: bad raw magic {magic:#x}")
-                return f.read()
+                data = f.read()
+                accounting.record_container_decode(len(data))
+                return data
         except FileNotFoundError:
             pass
         try:
@@ -484,6 +505,7 @@ class ContainerStore:
             raise IOError(f"container {cid}: bad magic {magic:#x}")
         data = codecs.decompress(codecs.CODEC_NAMES[codec_id],
                                  blob[_SEAL_HDR.size:], usize)
+        accounting.record_container_decode(len(data))
         with self._cache_lock:
             self._cache.pop(cid, None)  # keep the re-insert most-recent
             self._cache[cid] = data

@@ -32,6 +32,16 @@ Finished timelines observe per-phase latency histograms
 as extra labels) and overlap gauges into the ``write_profiler`` registry, so
 every surface the observability spine already reaches (/prom, /metrics,
 status_http.py, the gateway) serves them with no extra wiring.
+
+The READ path (server/block_sender.py serve_read, the short-circuit server
+and the EC degraded read) opens the same machinery via
+:func:`read_timeline`: phases ``index_lookup``/``cache_probe``/
+``container_decode`` (host), ``ec_gather``/``net_send`` (transport) and the
+ledger-fed ``device_wait`` partition one serve's wall clock identically,
+observing ``phase_us|op=read,phase=<name>`` histograms plus read-side
+overlap gauges into the ``read_profiler`` registry — the serving-path twin
+the reference never decomposes (DataNodeMetrics.java:553-560 counts read
+ops, never where a read's time went).
 """
 
 from __future__ import annotations
@@ -48,6 +58,7 @@ from typing import Any, Iterable, Iterator
 from . import metrics, tracing
 
 _M = metrics.registry("write_profiler")
+_R = metrics.registry("read_profiler")
 
 # Overlap classes, in wall-clock partition PRIORITY order (PERF_NOTES round
 # 4: the 1-vCPU host is the scarce resource — an interval where host work
@@ -62,14 +73,22 @@ PHASE_CLASS = {
     "reduce_compute": HOST, "checksum": HOST, "buffer_assemble": HOST,
     "pipeline_submit": HOST,
     "device_wait": DEVICE,
+    # Read-path phases (server/block_sender.py serve_read/read_logical):
+    # index/cache/decode burn the single vCPU; stripe gathers and the
+    # packet run to the client are network waits the host could hide.
+    "index_lookup": HOST, "cache_probe": HOST, "container_decode": HOST,
+    "ec_gather": TRANSPORT, "net_send": TRANSPORT,
 }
 
 # Deterministic attribution order when several phases of the winning class
 # overlap inside one elementary interval (rare: host phases are serial on
-# this host) — first match wins.
+# this host) — first match wins.  Nested read phases (index_lookup inside a
+# container_decode window) resolve to the innermost by listing it first.
 PHASE_ORDER = ("device_wait", "wal_commit", "container_io", "dedup_lookup",
                "reduce_compute", "checksum", "buffer_assemble",
-               "pipeline_submit", "recv", "mirror_stream", "ack")
+               "pipeline_submit", "index_lookup", "cache_probe",
+               "container_decode", "recv", "mirror_stream", "ack",
+               "ec_gather", "net_send")
 
 
 def phase_class(name: str) -> str:
@@ -91,6 +110,7 @@ _COUNTER_RING_MAX = 8192  # counter-track samples
 
 _lock = threading.Lock()
 _timelines: deque["BlockTimeline"] = deque(maxlen=_RING_MAX)
+_read_timelines: deque["BlockTimeline"] = deque(maxlen=_RING_MAX)
 _span_ring: deque[tuple] = deque(maxlen=_SPAN_RING_MAX)
 _counter_ring: deque[dict[str, Any]] = deque(maxlen=_COUNTER_RING_MAX)
 _counters: dict[str, float] = {}
@@ -247,6 +267,49 @@ def block_timeline(block_id: int, nbytes: int = 0) -> Iterator[BlockTimeline]:
         with _lock:
             _timelines.append(tl)
         _observe_finished(tl)
+
+
+@contextlib.contextmanager
+def read_timeline(block_id: int, nbytes: int = 0) -> Iterator[BlockTimeline]:
+    """Open the ambient timeline for one block READ (serve_read /
+    short-circuit serve / EC degraded read).  Same BlockTimeline machinery
+    and exclusive-class partition as the write side — reconstruct code
+    below it records ``index_lookup``/``container_decode``/``ec_gather``
+    phases via the ordinary :func:`phase` ambient channel, and the device
+    ledger's readback hook still lands ``device_wait`` spans — but finished
+    timelines ring separately and observe into the ``read_profiler``
+    registry as ``phase_us|op=read,phase=<name>`` histograms, so the read
+    families sit next to the write families on /prom."""
+    tl = BlockTimeline(block_id, nbytes)
+    tok = _current.set(tl)
+    counter_add("inflight_reads", 1)
+    try:
+        yield tl
+    finally:
+        _current.reset(tok)
+        counter_add("inflight_reads", -1)
+        tl.finish()
+        with _lock:
+            _read_timelines.append(tl)
+        _observe_finished_read(tl)
+
+
+def _observe_finished_read(tl: BlockTimeline) -> None:
+    prof = tl.profile()
+    for name, s in prof["phases"].items():
+        _R.observe(f"phase_us|op=read,phase={name}", s * 1e6)
+    _R.observe("read_wall_us", prof["wall_s"] * 1e6)
+    _R.gauge("overlap_efficiency", prof["overlap_efficiency"])
+    _R.gauge("attributed_frac", prof["attributed_frac"])
+    _R.incr("reads_profiled")
+
+
+def read_timelines_snapshot(limit: int = _RING_MAX) -> list[dict[str, Any]]:
+    """Newest-last finished READ timelines as JSON-safe dicts — the
+    read-path acceptance smoke's and slo_report's in-process source."""
+    with _lock:
+        tls = list(_read_timelines)
+    return [t.snapshot() for t in tls[-limit:]]
 
 
 def current_timeline() -> BlockTimeline | None:
@@ -439,6 +502,7 @@ def reset() -> None:
     write_profiler registry's cumulative metrics are left alone."""
     with _lock:
         _timelines.clear()
+        _read_timelines.clear()
         _span_ring.clear()
         _counter_ring.clear()
         _counters.clear()
