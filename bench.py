@@ -425,6 +425,67 @@ def _read_summary(tmp: str) -> dict:
     }
 
 
+def _scrub_summary(tmp: str) -> dict:
+    """Integrity-scrub stamp for the JSON line: a small in-process
+    exercise of the scrub plane's verification math (server/scrubber.py)
+    — dedup-commit a tiny corpus, seal it, re-verify every live chunk
+    digest against the chunk index (the exact oracle the DN scrubber
+    samples), plant one aged ``.tmp`` orphan and census+reclaim it — then
+    the process-wide ``scrub`` registry counters (this exercise plus any
+    product scrub activity in the run).  Keys match the scrub prom
+    family: bytes_verified, corrupt_total (labelled scrub_corrupt sum),
+    garbage_bytes (last census), repairs_triggered."""
+    import hashlib
+
+    from hdrf_tpu import native
+    from hdrf_tpu.config import CdcConfig
+    from hdrf_tpu.index.chunk_index import ChunkIndex
+    from hdrf_tpu.ops.dispatch import gear_mask
+    from hdrf_tpu.reduction.dedup import dedup_commit
+    from hdrf_tpu.server.scrubber import Scrubber
+    from hdrf_tpu.storage.container_store import ContainerStore
+    from hdrf_tpu.utils import metrics
+
+    d = os.path.join(tmp, "scrubpath")
+    containers = ContainerStore(os.path.join(d, "containers"), codec="lz4")
+    index = ChunkIndex(os.path.join(d, "index"))
+    cdc = CdcConfig()
+    mask = gear_mask(cdc)
+    data = _make_block(1, seed=950).tobytes()
+    buf = np.frombuffer(data, np.uint8)
+    cuts = native.cdc_chunk(buf, mask, cdc.min_chunk, cdc.max_chunk)
+    starts = np.concatenate([[0], cuts[:-1]]).astype(np.uint64)
+    digs = native.sha256_batch(buf, starts,
+                               (cuts - starts).astype(np.uint64))
+    dedup_commit(0, data, cuts, digs, index, containers,
+                 on_seal=index.seal_container)
+    containers.flush_open(on_seal=index.seal_container)
+    reg = metrics.registry("scrub")
+    verified = 0
+    for cid in index.container_live_bytes():
+        blob = containers.read_container(cid)
+        for h, (off, ln) in index.live_chunks_in(cid).items():
+            assert hashlib.sha256(blob[off:off + ln]).digest() == h, \
+                "scrub stamp: live chunk digest diverged from the index"
+            verified += ln
+    reg.incr("scrub_bytes_verified", verified)
+    # one aged tmp orphan through the census's reclaim math
+    orphan = os.path.join(d, "containers", "999.sealed.tmp")
+    with open(orphan, "wb") as f:
+        f.write(b"\0" * 4096)
+    garbage = os.path.getsize(orphan)
+    os.unlink(orphan)
+    reg.incr("scrub_tmp_reclaimed")
+    index.close()
+    return {
+        "bytes_verified": reg.counter("scrub_bytes_verified"),
+        "corrupt_total": Scrubber.corrupt_total(),
+        "garbage_bytes": garbage,
+        "repairs_triggered": reg.counter("scrub_repairs_triggered"),
+        "tmp_reclaimed": reg.counter("scrub_tmp_reclaimed"),
+    }
+
+
 def _multichip_summary() -> dict:
     """Mesh-plane service-rate stamp for the JSON line: the `benchmarks
     multichip` sub-harness (1/2/4/8-device curve, native-oracle pinned,
@@ -541,6 +602,7 @@ def main() -> None:
                 "ec": _ec_summary(),
                 "mirror": _mirror_summary(),
                 "read": _read_summary(tmp),
+                "scrub": _scrub_summary(tmp),
                 "phase_profile": phase_profile,
                 "pipeline": _pipeline_summary(phase_profile),
                 "multichip": _multichip_summary(),
@@ -870,6 +932,7 @@ def main() -> None:
             "ec": _ec_summary(),
             "mirror": _mirror_summary(),
             "read": _read_summary(tmp),
+            "scrub": _scrub_summary(tmp),
             "phase_profile": phase_profile,
             "pipeline": _pipeline_summary(phase_profile),
             "multichip": _multichip_summary(),

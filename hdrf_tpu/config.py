@@ -304,6 +304,20 @@ class DataNodeConfig:
     # disables the sampler thread.
     flight_interval_s: float = 1.0
     flight_capacity: int = 512
+    # Continuous integrity scrub (server/scrubber.py): background cycle
+    # re-verifying sealed containers / EC stripes / replica invariants and
+    # taking the garbage census.  interval <= 0 disables the loop (the
+    # default: tests and operators opt in); the rate cap bounds scrub disk
+    # reads (VolumeScanner's dfs.block.scanner.volume.bytes.per.second
+    # analog); sample_frac is the fraction of a container's live chunks
+    # digest-verified per cycle (1.0 = every chunk).
+    scrub_interval_s: float = 0.0
+    scrub_rate_mb_s: float = 8.0
+    scrub_sample_frac: float = 0.25
+    # Crashed tmp+fsync+replace writes (container seal, stripe put,
+    # mirror-segment put) leave *.tmp orphans; the scrubber reclaims ones
+    # older than this (young tmps may still be mid-replace).
+    scrub_tmp_age_s: float = 300.0
     reduction: ReductionConfig = field(default_factory=ReductionConfig)
 
 

@@ -132,6 +132,13 @@ class ChunkIndex:
         # incremental refresh feed) — registered before _recover() so
         # replay-applied records also notify.
         self._listeners: list = []
+        # dedup-race loser bytes per container: both writers appended the
+        # chunk, one commit won, the loser's container bytes are orphans.
+        # In-memory advisory accounting (not WAL'd — a restart folds prior
+        # orphans into the generic dead-bytes delta); the scrubber's
+        # garbage census splits `garbage_bytes|class=orphan_append` out of
+        # the payload-minus-live delta with it.
+        self._orphans: dict[int, int] = {}
         self._recover()
         self._wal = open(os.path.join(directory, WAL_NAME), "ab")
 
@@ -268,6 +275,7 @@ class ChunkIndex:
                 for h, loc in new_chunks.items():
                     if h in self._chunks or h in seen_new:
                         losers.append(h)
+                        self._note_orphan_locked(loc)
                     else:
                         fresh[h] = loc
                         seen_new.add(h)
@@ -306,6 +314,8 @@ class ChunkIndex:
                 (block_id, logical_len, hashes, new_chunks))
         with profiler.phase("wal_commit"), self._lock:
             losers = [h for h in new_chunks if h in self._chunks]
+            for h in losers:
+                self._note_orphan_locked(new_chunks[h])
             fresh = {h: loc for h, loc in new_chunks.items() if h not in self._chunks}
             for h in hashes:
                 if h not in self._chunks and h not in fresh:
@@ -379,6 +389,7 @@ class ChunkIndex:
                     for h, loc in new_chunks.items():
                         if h in self._chunks or h in seen_new:
                             losers.append(h)
+                            self._note_orphan_locked(loc)
                         else:
                             fresh[h] = loc
                     for h in hashes:
@@ -510,6 +521,21 @@ class ChunkIndex:
         with self._lock:
             return {h: (c.offset, c.length) for h, c in self._chunks.items()
                     if c.container_id == container_id}
+
+    def _note_orphan_locked(self, loc) -> None:
+        """Attribute one dedup-race loser's appended bytes to its container
+        (caller holds ``_lock``); ``loc`` is the loser's declared
+        (container_id, offset, length)."""
+        cid, _off, ln = loc
+        self._orphans[cid] = self._orphans.get(cid, 0) + int(ln)
+
+    def orphan_bytes(self) -> dict[int, int]:
+        """container_id -> cumulative dedup-race loser bytes appended since
+        startup (advisory, in-memory: restarts fold prior orphans back
+        into the generic dead-bytes delta).  The scrubber census subtracts
+        this class out of payload-minus-live garbage."""
+        with self._lock:
+            return dict(self._orphans)
 
     def stats(self) -> dict:
         with self._lock:

@@ -313,6 +313,11 @@ class DataNode:
         # serial push_reduced relay through this object unchanged
         from hdrf_tpu.server.mirror_plane import MirrorPlane
         self.mirror = MirrorPlane(self)
+        # integrity-scrub plane (server/scrubber.py): container/stripe/
+        # replica re-verification + garbage census; loop gated on
+        # scrub_interval_s > 0, tests drive run_cycle() directly
+        from hdrf_tpu.server.scrubber import Scrubber
+        self.scrubber = Scrubber(self)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._ibr_queue: list[tuple[int, int, int, str | None, bool]] = []
@@ -421,6 +426,11 @@ class DataNode:
                                   name=f"{self.dn_id}-scanner", daemon=True)
             sc.start()
             self._threads.append(sc)
+        if self.config.scrub_interval_s > 0:
+            sb = threading.Thread(target=self._scrub_loop,
+                                  name=f"{self.dn_id}-scrubber", daemon=True)
+            sb.start()
+            self._threads.append(sb)
         if self.config.volume_check_interval_s > 0 \
                 and not self.config.simulated_dataset:
             vc = threading.Thread(target=self._volume_check_loop,
@@ -544,6 +554,13 @@ class DataNode:
         # ... and revokes outstanding short-circuit grants for the same
         # reason (a cached client fd still maps the superseded inode)
         self._sc.registry.revoke(block_id)
+        if not partial:
+            # a FULL replica landing (any path: direct receive, replicate
+            # push, ec reconstruct, mirror assemble) shadows any partial
+            # mirror segments still held for the block — reclaim them now
+            # instead of leaking them as garbage (on_full_replica is
+            # idempotent: it only counts when segments were dropped)
+            self.mirror.on_full_replica(block_id)
         self._ibr_queue.append((block_id, length, gen_stamp, storage_type,
                                 partial))
         self._ibr_event.set()
@@ -998,6 +1015,10 @@ class DataNode:
             "breakers_half_open": sum(1 for s in states
                                       if s == "half_open"),
             "tenant_count": tenants.tenant_count(),
+            # integrity-drift curve (ISSUE 12 satellite: garbage growth
+            # and corruption rate belong in the /timeseries regressions)
+            "garbage_bytes": sum(self.scrubber._last_census.values()),
+            "scrub_corrupt_total": self.scrubber.corrupt_total(),
         }
 
     def _stats(self) -> dict:
@@ -1021,6 +1042,7 @@ class DataNode:
             "index": self.index.stats(),
             "ec": self.ec.report(),
             "mirror": self.mirror.report(),
+            "scrub": self.scrubber.report(),
         }
 
     def _execute(self, cmd: dict) -> None:
@@ -1159,7 +1181,7 @@ class DataNode:
     RECONFIGURABLE = frozenset({
         "scan_interval_s", "volume_check_interval_s",
         "block_report_interval_s", "cache_capacity",
-        "balancer_bandwidth",
+        "balancer_bandwidth", "scrub_interval_s",
     })
 
     def reconfigure(self, key: str, value) -> dict:
@@ -1182,7 +1204,8 @@ class DataNode:
                         "error": f"{key} must be > 0 (disabling a loop "
                                  "requires a restart)"}
             thread_of = {"scan_interval_s": "-scanner",
-                         "volume_check_interval_s": "-volcheck"}
+                         "volume_check_interval_s": "-volcheck",
+                         "scrub_interval_s": "-scrubber"}
             suffix = thread_of.get(key)
             if suffix is not None and not any(
                     t.name.endswith(suffix) and t.is_alive()
@@ -1409,6 +1432,18 @@ class DataNode:
                 _M.incr("scanner_errors")
             except Exception:  # noqa: BLE001
                 _M.incr("scanner_errors")
+
+    def _scrub_loop(self) -> None:
+        """Integrity-scrub driver (server/scrubber.py): one full cycle per
+        wakeup; interval re-read each tick (live-reconfigurable)."""
+        _SCRUB = metrics.registry("scrub")
+        while not self._stop.wait(self.config.scrub_interval_s):
+            try:
+                self.scrubber.run_cycle()
+            except (OSError, ConnectionError):
+                _SCRUB.incr("scrub_errors")
+            except Exception:  # noqa: BLE001
+                _SCRUB.incr("scrub_errors")
 
     def verify_block(self, block_id: int) -> bool:
         """True if the replica is corrupt (stored checksums don't match).

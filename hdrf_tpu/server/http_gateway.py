@@ -158,6 +158,8 @@ class HttpGateway:
                         return self._json(200, gateway.stacks())
                     if u.path == "/timeseries":
                         return self._json(200, gateway.timeseries())
+                    if u.path == "/fsck":
+                        return self._json(200, gateway.fsck())
                     if not u.path.startswith(PREFIX):
                         return self._json(404, {"error": "not found"})
                     path = unquote(u.path[len(PREFIX):]) or "/"
@@ -465,10 +467,15 @@ class HttpGateway:
                          namenode=str(self._nn_addr))
             return {"status": "unreachable", "namenode": str(self._nn_addr)}
         degraded_nodes = slow.get("degraded_nodes") or []
+        fsck_violations = int(cluster.get("fsck_violations", 0))
+        scrub_corrupt = int(cluster.get("scrub_corrupt_total", 0))
         degraded = (cluster["dead"] > 0 or cluster["safemode"]
                     or cluster["under_replicated"] > 0
                     or slow["slow_peers"] or slow["slow_volumes"]
-                    or bool(degraded_nodes))
+                    or bool(degraded_nodes)
+                    # integrity plane: invariant-census violations or
+                    # scrub-confirmed corruption flip the verdict too
+                    or fsck_violations > 0 or scrub_corrupt > 0)
         return {"status": "degraded" if degraded else "healthy",
                 "role": cluster["role"],
                 "safemode": cluster["safemode"],
@@ -491,7 +498,25 @@ class HttpGateway:
                 "stripe_logical_bytes":
                     cluster.get("stripe_logical_bytes", 0),
                 "stripe_physical_bytes":
-                    cluster.get("stripe_physical_bytes", 0)}
+                    cluster.get("stripe_physical_bytes", 0),
+                # integrity plane (ISSUE 12): the census + scrub verdicts
+                # behind the degraded expression above
+                "fsck_violations": fsck_violations,
+                "scrub_corrupt_total": scrub_corrupt,
+                "garbage_bytes": cluster.get("garbage_bytes", 0),
+                "scrub_repairs_triggered":
+                    cluster.get("scrub_repairs_triggered", 0)}
+
+    def fsck(self) -> dict:
+        """Gateway face of the NN invariant census (``rpc_fsck``): runs the
+        reconciliation NOW and relays the per-class verdict."""
+        try:
+            with HdrfClient(self._nn_addr, name="http-gw") as c:
+                return c._call("fsck")
+        except (OSError, ConnectionError):
+            _M.incr("fsck_nn_unreachable")
+            return {"status": "unreachable",
+                    "namenode": str(self._nn_addr)}
 
     def metrics(self) -> dict:
         with HdrfClient(self._nn_addr, name="http-gw") as c:

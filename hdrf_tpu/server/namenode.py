@@ -307,6 +307,16 @@ class NameNode:
         self._stripe_groups: dict[tuple[str, int], dict] = {}
         self._pending_demote: dict[int, float] = {}       # bid -> deadline
         self._pending_stripe_repair: dict[tuple[str, int], float] = {}
+        # scrub-confirmed corrupt stripes on LIVE holders (rpc_bad_stripe):
+        # (owner, cid) -> stripe indices needing re-decode.  The stripe-
+        # repair monitor unions these into its dead-holder `missing` set so
+        # one scheduler handles both loss modes; cleared when the owner's
+        # stripe_complete report lands (repair done) or the group vanishes.
+        self._corrupt_stripes: dict[tuple[str, int], set[int]] = {}
+        # last invariant-census result (_check_fsck monitor pass) — what
+        # rpc_cluster_status and the gateway /health verdict read without
+        # re-walking the block map per page load
+        self._last_fsck: dict | None = None
         # Stripe manifests journaled at demote/repair time (editlog +
         # fsimage durable, unlike the soft _stripe_groups cache) so
         # owner-loss repair can rebuild a container's stripes after the
@@ -2780,6 +2790,7 @@ class NameNode:
             logical = physical = cached = 0
             ded_logical = ded_unique = 0
             ec_striped = ec_logical = ec_physical = 0
+            scrub_corrupt = scrub_garbage = scrub_repairs = 0
             for d in self._datanodes.values():
                 alive = (now - d.last_heartbeat
                          < self.config.dead_node_interval_s)
@@ -2800,6 +2811,10 @@ class NameNode:
                 ec_striped += int(ec.get("striped_containers", 0))
                 ec_logical += int(ec.get("stripe_logical_bytes", 0))
                 ec_physical += int(ec.get("stripe_physical_bytes", 0))
+                sc = st.get("scrub") or {}
+                scrub_corrupt += int(sc.get("corrupt_total", 0))
+                scrub_garbage += int(sc.get("garbage_bytes", 0))
+                scrub_repairs += int(sc.get("repairs_triggered", 0))
             # The under-replicated count is the redundancy monitor's own
             # (cached each _check_replication tick) — recomputing it here
             # would both duplicate the want/counted semantics and walk
@@ -2838,6 +2853,14 @@ class NameNode:
                 "slow_volumes": len(health["slow_volumes"]),
                 "reduction_degraded": len(health["degraded_nodes"]),
                 "degraded_nodes": health["degraded_nodes"],
+                # integrity plane: DN heartbeat scrub aggregates + the
+                # cached invariant-census verdict (the /health gateway
+                # extends its degraded expression with these)
+                "scrub_corrupt_total": scrub_corrupt,
+                "garbage_bytes": scrub_garbage,
+                "scrub_repairs_triggered": scrub_repairs,
+                "fsck_violations": (self._last_fsck or {}).get(
+                    "violations", 0),
                 "editlog_seq": self._editlog.seq,
                 "journal_addrs": [list(a) for a in
                                   (self.config.journal_addrs or [])],
@@ -2862,6 +2885,7 @@ class NameNode:
             # owner dropped the manifest (container deleted/promoted)
             del self._stripe_groups[key]
             self._pending_stripe_repair.pop(key, None)
+            self._corrupt_stripes.pop(key, None)
 
     def rpc_stripe_complete(self, dn_id: str, block_id=None,
                             containers: list | None = None,
@@ -2899,6 +2923,7 @@ class NameNode:
                         "length": int(c.get("logical", 0)),
                         "block_id": block_id}
                     self._pending_stripe_repair.pop(key, None)
+                    self._corrupt_stripes.pop(key, None)
 
             if block_id is None:
                 # repair of an unmapped group: re-journal + cache manifests
@@ -2975,6 +3000,119 @@ class NameNode:
                 "storage_ratio_replicated": float(self.config.replication),
             }
 
+    def _fsck_census(self) -> dict:
+        """Invariant reconciliation over the whole namesystem (NamenodeFsck
+        analog, §blockIdCK): block map vs live DN membership, reported
+        replica lengths, stripe-group decodability, and partial-replica
+        coverage.  Caller holds ``self._lock``.  Classes:
+
+        - ``missing``: a COMPLETE block with zero live full replicas and no
+          other byte source (no partial mirror segments awaiting upgrade,
+          no stripe demotion, not an EC-group internal block).
+        - ``extra``: a DN claims a block the map no longer knows (missed
+          invalidation — the reference's invalidateBlocks backlog).
+        - ``length_mismatch``: a live current-generation replica reports a
+          length different from the committed block length (the torn-
+          finalize class the shadow-block design stopped checking).
+        - ``unrepairable_stripe``: a stripe group (or EC block group) with
+          fewer than k intact+live members — any-k decode is dead and only
+          re-replication from outside sources could help.
+        """
+        now = time.monotonic()
+        dead_after = self.config.dead_node_interval_s
+
+        def _alive(dn_id: str) -> bool:
+            d = self._datanodes.get(dn_id)
+            return (d is not None
+                    and now - d.last_heartbeat < dead_after)
+
+        ec_bids = {b for g in self._groups.values() for b in g.bids}
+        striped_bids = {g.get("block_id")
+                        for g in self._stripe_groups.values()}
+        missing: list[int] = []
+        length_mismatch: list[int] = []
+        partial_covered = 0
+        for bid, info in self._blocks.items():
+            node = self._try_file(info.path)
+            if node is None or not node.complete:
+                continue
+            live = {d for d in info.locations if _alive(d)}
+            if not live and bid not in ec_bids:
+                if self._partial_replicas.get(bid):
+                    partial_covered += 1  # upgrade monitor's problem
+                elif not (bid in self._ec_demoted and bid in striped_bids):
+                    missing.append(bid)
+            if info.length >= 0:
+                for d in live:
+                    rep = info.reported.get(d)
+                    if (rep is not None and rep[0] == info.gen_stamp
+                            and rep[1] != info.length):
+                        length_mismatch.append(bid)
+                        break
+        extra: list[int] = []
+        for d in self._datanodes.values():
+            if not _alive(d.dn_id):
+                continue
+            for bid in d.blocks:
+                if bid not in self._blocks:
+                    extra.append(bid)
+        unrepairable: list[list] = []
+        for (owner, cid), grp in self._stripe_groups.items():
+            man = self._stripe_manifests.get((owner, cid)) or {}
+            k = int(man.get("k", self.config.ec_data_shards))
+            corrupt = self._corrupt_stripes.get((owner, cid), set())
+            intact = sum(1 for i, h in enumerate(grp["holders"])
+                         if i not in corrupt and _alive(h[0]))
+            if intact < k:
+                unrepairable.append([owner, cid])
+        for gid, g in self._groups.items():
+            k = self.config.ec_data_shards
+            live_members = sum(
+                1 for b in g.bids
+                if any(_alive(d)
+                       for d in (self._blocks.get(b).locations
+                                 if self._blocks.get(b) else ())))
+            if live_members < k:
+                unrepairable.append(["ec_group", gid])
+        classes = {"missing": sorted(missing),
+                   "extra": sorted(set(extra)),
+                   "length_mismatch": sorted(length_mismatch),
+                   "unrepairable_stripe": sorted(unrepairable)}
+        counts = {c: len(v) for c, v in classes.items()}
+        violations = sum(counts.values())
+        return {
+            "healthy": violations == 0,
+            "violations": violations,
+            "counts": counts,
+            # per-class ids, capped so a mass-failure fsck stays shippable
+            # over the RPC (the counts above are exact)
+            **{c: v[:50] for c, v in classes.items()},
+            "blocks_checked": len(self._blocks),
+            "partial_covered": partial_covered,
+            "corrupt_stripes_pending": sum(
+                len(v) for v in self._corrupt_stripes.values()),
+        }
+
+    def rpc_fsck(self) -> dict:
+        """dfsadmin -fsck / gateway /fsck: run the invariant census NOW and
+        return the verdict (also refreshing the cached copy /health and
+        cluster_status read)."""
+        with self._lock:
+            census = self._fsck_census()
+            self._last_fsck = census
+            return census
+
+    def _check_fsck(self) -> None:
+        """Monitor pass: refresh the invariant census each tick and export
+        the violation gauges (the fsck analog of _check_replication's
+        cached under-replication count)."""
+        with self._lock:
+            census = self._fsck_census()
+            self._last_fsck = census
+            _M.gauge("fsck_violations", census["violations"])
+            for cls, n in census["counts"].items():
+                _M.gauge(f"fsck_{cls}", n)
+
     def rpc_finalize_upgrade(self) -> dict:
         """dfsadmin -finalizeUpgrade: drop this NameNode's rollback
         snapshot and queue a finalize command to every DataNode (the
@@ -3016,6 +3154,23 @@ class NameNode:
             _M.incr("corrupt_replicas_reported")
             self._logger.warning("corrupt replica reported", dn_id=dn_id,
                               block_id=block_id)
+            return True
+
+    def rpc_bad_stripe(self, dn_id: str, owner: str, cid: int,
+                       idx: int) -> bool:
+        """A DN's scrubber found a corrupt EC stripe it does NOT own (no
+        local manifest to repair against): record the index so the stripe-
+        repair monitor schedules the owner's any-k re-decode — the
+        markBlockAsCorrupt path applied to the cold tier's stripes."""
+        with self._lock:
+            key = (owner, int(cid))
+            self._corrupt_stripes.setdefault(key, set()).add(int(idx))
+            # clear the repair backoff: a corruption report should not
+            # wait out a prior schedule's deadline
+            self._pending_stripe_repair.pop(key, None)
+            _M.incr("corrupt_stripes_reported")
+            self._logger.warning("corrupt stripe reported", dn_id=dn_id,
+                                 owner=owner, cid=int(cid), idx=int(idx))
             return True
 
     def rpc_datanode_blocks(self, dn_id: str, limit: int = 100) -> list[int]:
@@ -3104,6 +3259,19 @@ class NameNode:
                 "pending_replication": len(self._pending_repl),
                 "pending_recovery": len(self._pending_recovery),
                 "safemode": int(self._safemode_forced or self._safemode_auto),
+                # integrity drift: the cached invariant-census verdict plus
+                # the DN heartbeats' scrub aggregates, so corruption and
+                # garbage growth show in the /timeseries regression table
+                "fsck_violations": (self._last_fsck or {}).get(
+                    "violations", 0),
+                "garbage_bytes": sum(
+                    int(((d.stats or {}).get("scrub") or {})
+                        .get("garbage_bytes", 0))
+                    for d in self._datanodes.values()),
+                "scrub_corrupt_total": sum(
+                    int(((d.stats or {}).get("scrub") or {})
+                        .get("corrupt_total", 0))
+                    for d in self._datanodes.values()),
             }
         states = [b.state for b in retry.all_breakers().values()]
         sample["breakers_open"] = sum(1 for s in states if s == "open")
@@ -3684,6 +3852,7 @@ class NameNode:
                 self._recover_leases()
                 self._check_ec_demotion()
                 self._check_stripe_repair()
+                self._check_fsck()
                 with self._lock:
                     self._dtokens.purge_expired()
                 if self._editlog.should_checkpoint():
@@ -3990,8 +4159,15 @@ class NameNode:
                     if d is None or now - d.last_heartbeat >= dead_after:
                         missing.append(idx)
                 key = (owner_id, cid)
+                # scrub-confirmed corrupt stripes on live holders repair
+                # through the same scheduler as dead-holder losses
+                corrupt = self._corrupt_stripes.get(key, set())
+                missing = sorted(set(missing)
+                                 | {i for i in corrupt
+                                    if i < len(grp["holders"])})
                 if not missing:
                     self._pending_stripe_repair.pop(key, None)
+                    self._corrupt_stripes.pop(key, None)
                     continue
                 if self._pending_stripe_repair.get(key, 0.0) > now:
                     continue

@@ -573,6 +573,29 @@ class ContainerStore:
         except OSError:
             return 0
 
+    def quarantine(self, cid: int) -> int:
+        """Rename the container's files aside (``.quar`` suffix) so it can
+        never be served again — a scrub-confirmed corrupt container must
+        not satisfy another read, across restarts included
+        (markBlockAsCorrupt's never-serve guarantee applied to the shared
+        container).  A rename, not an unlink: the corrupt bytes stay on
+        disk for forensics and are censused as
+        ``garbage_bytes|class=quarantined`` until GC reclaims them.  Does
+        NOT fire ``_on_delete`` (the container remains logically present;
+        re-replication restores its blocks elsewhere).  Returns bytes
+        quarantined."""
+        moved = 0
+        for p in (self._raw_path(cid), self._sealed_path(cid)):
+            try:
+                size = os.path.getsize(p)
+                os.rename(p, p + ".quar")
+                moved += size
+            except OSError:
+                continue
+        with self._cache_lock:
+            self._cache.pop(cid, None)
+        return moved
+
     def delete_container(self, cid: int) -> None:
         for p in (self._raw_path(cid), self._sealed_path(cid)):
             if os.path.exists(p):

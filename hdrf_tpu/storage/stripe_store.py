@@ -184,6 +184,22 @@ class StripeStore:
         _M.incr("stripe_bytes_deleted", freed)
         return freed
 
+    def quarantine(self, owner: str, cid: int, idx: int) -> int:
+        """Rename one scrub-confirmed corrupt stripe aside (``.quar``) so
+        no gather/decode can pick it up again — reconstruct_container
+        already CRC-filters corrupt stripes as erasures, but a renamed
+        file also survives restarts and stops counting as a holder.
+        Returns bytes quarantined."""
+        p = self._path(owner, cid, idx)
+        with self._lock:
+            try:
+                size = os.path.getsize(p)
+                os.rename(p, p + ".quar")
+            except OSError:
+                return 0
+        _M.incr("stripe_quarantined")
+        return size
+
     def iter_stripes(self) -> Iterator[tuple[str, int, int, int]]:
         """Yield (owner, cid, idx, nbytes) for every local stripe file."""
         for name in sorted(os.listdir(self._dir)):

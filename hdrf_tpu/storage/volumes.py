@@ -470,6 +470,9 @@ class MultiContainerStore:
     def delete_container(self, cid: int) -> None:
         self._vs.volume_of_cid(cid).containers.delete_container(cid)
 
+    def quarantine(self, cid: int) -> int:
+        return self._vs.volume_of_cid(cid).containers.quarantine(cid)
+
     def sealed_file_bytes(self, cid: int) -> bytes | None:
         return self._vs.volume_of_cid(cid).containers.sealed_file_bytes(cid)
 

@@ -180,7 +180,8 @@ def main(argv: list[str] | None = None) -> int:
     problems = (check(root) + check_fault_points(root)
                 + check_prom_metrics(root) + check_bench_contract(root)
                 + check_bench_contract(root, key="mirror")
-                + check_bench_contract(root, key="read"))
+                + check_bench_contract(root, key="read")
+                + check_bench_contract(root, key="scrub"))
     for p in problems:
         print(p)
     print(f"{len(problems)} violation(s)" if problems

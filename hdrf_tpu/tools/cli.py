@@ -13,7 +13,7 @@ dispatch; DFSAdmin.java:441, OfflineImageViewer / OfflineEditsViewer under
                            -chmod -chown -getfacl -setfacl -setfattr -getfattr
   mover                    migrate replicas to satisfy storage policies
   dfsadmin                 -report -savenamespace -metrics -slowPeers
-                           -ecStatus
+                           -ecStatus -fsck
                            -movblock -setBalancerBandwidth -provide
                            -allowSnapshot -setQuota -setSpaceQuota -clrQuota
                            -safemode -decommission -decommissionStatus
@@ -304,6 +304,11 @@ def cmd_dfsadmin(args) -> int:
                   f"ratio={es['storage_ratio_striped']:.2f}x "
                   f"(replicated tier: "
                   f"{es['storage_ratio_replicated']:.1f}x)")
+        elif args.op == "-fsck":
+            # invariant census (NamenodeFsck analog): block map vs live
+            # DN membership, reported lengths, stripe decodability —
+            # JSON verdict with per-class violation ids
+            print(json.dumps(c._call("fsck"), indent=2, sort_keys=True))
         elif args.op == "-finalizeUpgrade":
             r = c._call("finalize_upgrade")
             print(f"finalized: namenode={r['namenode_finalized']} "

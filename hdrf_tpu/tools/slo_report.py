@@ -43,7 +43,10 @@ SMOKE_ROUNDS = 4
 REGRESS_UP = ("read_p95_ms", "write_p95_ms", "stalls", "breakers_open",
               "breakers_half_open", "storage_ratio", "under_replicated",
               "pending_replication", "pending_recovery", "safemode",
-              "read_amplification")
+              "read_amplification",
+              # integrity drift (ISSUE 12): garbage growth and scrub/fsck
+              # corruption counts only ever regress upward
+              "garbage_bytes", "scrub_corrupt_total", "fsck_violations")
 REGRESS_DOWN = ("container_cache_hit_ratio", "cache_hit_ratio",
                 "dedup_ratio", "datanodes_live")
 # Relative drift below this never flags (jitter floor), and a baseline of

@@ -1,0 +1,553 @@
+"""Continuous integrity-scrub & cluster invariant-audit plane.
+
+Covers the DN-side scrubber (server/scrubber.py: sampled chunk-digest
+re-verification of sealed containers, stripe CRC + any-k decode
+spot-checks, replica invariants, the four-class garbage census and its
+tmp/segment reclaim — the VolumeScanner.java:47 / DirectoryScanner.java:56
+re-expression over reduced storage), the detection->response wiring
+(quarantine-via-rename, rpc_bad_block / rpc_bad_stripe fan-in to the NN
+monitors, server/namenode.py:3139-3174), and the NN invariant census
+(``rpc_fsck``, server/namenode.py:3003 — the NamenodeFsck.java:112 analog)
+surfaced through ``dfsadmin -fsck``, the gateway's /fsck and the /health
+degraded verdict (server/http_gateway.py:454).
+
+Fault points exercised: "scrub.container", "scrub.stripe",
+"scrub.replica", "scrub.census".
+"""
+
+import io
+import json
+import os
+import random
+import time
+import urllib.request
+from contextlib import redirect_stdout
+
+import pytest
+
+from hdrf_tpu.server.http_gateway import HttpGateway
+from hdrf_tpu.server.scrubber import QUAR_SUFFIX, Scrubber
+from hdrf_tpu.testing.minicluster import MiniCluster
+from hdrf_tpu.tools import cli
+from hdrf_tpu.utils import fault_injection, metrics, retry
+
+_S = metrics.registry("scrub")
+_EC = metrics.registry("ec")
+_NN = metrics.registry("namenode")
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fault_injection.clear()
+    yield
+    fault_injection.clear()
+
+
+def run_cli(argv) -> tuple[int, str]:
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(argv)
+    return rc, buf.getvalue()
+
+
+def blob(seed: int, n: int) -> bytes:
+    return random.Random(seed).randbytes(n)
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.status == 200
+        return r.read()
+
+
+def _wait(pred, timeout=20.0, interval=0.1, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _cycle(dn, timeout: float = 12.0) -> dict:
+    """Run one scrub cycle NOW, riding out transient breaker vetoes left
+    behind by earlier cluster churn (breakers half-open within reset_s)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        before = _S.counter("scrub_cycles")
+        census = dn.scrubber.run_cycle()
+        if _S.counter("scrub_cycles") > before:
+            return census
+        if time.monotonic() > deadline:
+            raise AssertionError("scrub cycle stayed vetoed")
+        time.sleep(0.25)
+
+
+def _seal_all(dn) -> None:
+    dn.containers.flush_open(on_seal=dn.index.seal_container)
+    dn.containers.drain_seals()
+
+
+def _holder(mc):
+    for dn in mc.datanodes:
+        if dn is not None and dn.replicas.block_ids():
+            return dn
+    raise AssertionError("no datanode holds a replica")
+
+
+# ---------------------------------------------------- clean-cluster baseline
+
+
+class TestCleanCluster:
+    def test_no_false_positives_and_fsck_healthy(self):
+        """Acceptance gate: a healthy MiniCluster scrubs to ZERO corruption
+        across every class, the census finds no dead/orphan/tmp garbage,
+        and the invariant audit reports healthy."""
+        with MiniCluster(n_datanodes=3, replication=2) as mc:
+            payloads = {}
+            with mc.client("clean") as c:
+                for i, scheme in enumerate(("direct", "dedup", "dedup_lz4")):
+                    d = blob(40 + i, 96_000)
+                    c.write(f"/clean/{i}", d, scheme=scheme)
+                    payloads[i] = d
+            corrupt0 = Scrubber.corrupt_total()
+            for dn in mc.datanodes:
+                _seal_all(dn)
+                _cycle(dn)
+                # second cycle: foreign-stripe baselines and rotating
+                # cursors armed — still quiet
+                census = _cycle(dn)
+                assert census["dead_chunks"] == 0
+                assert census["orphan_append"] == 0
+                assert census["tmp"] == 0
+                assert census["quarantined"] == 0
+            assert Scrubber.corrupt_total() == corrupt0
+            with mc.client("clean") as c:
+                fs = c._call("fsck")
+                assert fs["healthy"] and fs["violations"] == 0
+                assert all(n == 0 for n in fs["counts"].values())
+                assert fs["blocks_checked"] >= 3
+                for i, d in payloads.items():
+                    assert c.read(f"/clean/{i}") == d
+
+    def test_fault_points_fire_and_report_shape(self):
+        """The scrubber's crash windows fire on every cycle leg; report()
+        carries the heartbeat census the NN aggregates."""
+        with MiniCluster(n_datanodes=1, replication=1) as mc:
+            with mc.client("fp") as c:
+                c.write("/fp/a", blob(9, 48_000), scheme="dedup")
+            dn = mc.datanodes[0]
+            _seal_all(dn)
+            seen = {"container": [], "replica": [], "census": []}
+            fault_injection.install(
+                "scrub.container", lambda **kw: seen["container"].append(kw))
+            fault_injection.install(
+                "scrub.replica", lambda **kw: seen["replica"].append(kw))
+            fault_injection.install(
+                "scrub.census", lambda **kw: seen["census"].append(kw))
+            _cycle(dn)
+            assert seen["container"] and seen["replica"] and seen["census"]
+            rep = dn.scrubber.report()
+            assert rep["cycles"] >= 1
+            assert rep["bytes_verified"] > 0
+            assert set(rep["garbage"]) == {"dead_chunks", "orphan_append",
+                                           "tmp", "mirror_segments",
+                                           "quarantined"}
+
+    def test_breaker_veto_skips_cycle(self):
+        """An open breaker edge vetoes the whole cycle (never add scrub
+        load to a sick node) and counts scrub_cycles_vetoed."""
+        with MiniCluster(n_datanodes=1, replication=1) as mc:
+            dn = mc.datanodes[0]
+            _cycle(dn)  # prove a cycle runs before the veto
+            b = retry.breaker("scrub-test-edge", failure_threshold=1,
+                              reset_s=60.0)
+            try:
+                b.record_failure()
+                assert b.state == "open"
+                v0 = _S.counter("scrub_cycles_vetoed")
+                c0 = _S.counter("scrub_cycles")
+                dn.scrubber.run_cycle()
+                assert _S.counter("scrub_cycles_vetoed") == v0 + 1
+                assert _S.counter("scrub_cycles") == c0
+            finally:
+                retry.reset_breakers()
+
+
+# ------------------------------------------------- container corruption e2e
+
+
+class TestContainerScrub:
+    def test_flipped_byte_quarantines_and_rereplicates(self):
+        """Acceptance path: one flipped byte in a sealed container is
+        detected within one cycle, the container is quarantined (never
+        served again), the NN re-replicates from the healthy peer, and the
+        repaired read is bit-identical to the original corpus."""
+        with MiniCluster(n_datanodes=3, replication=2,
+                         dn_config_overrides={"scrub_sample_frac": 1.0}) \
+                as mc:
+            d = blob(1, 120_000)
+            with mc.client("it") as c:
+                c.write("/scrub/a", d, scheme="dedup")
+                assert c.read("/scrub/a") == d
+            victim = _holder(mc)
+            _seal_all(victim)
+            cids = sorted(victim.index.container_live_bytes())
+            assert cids
+            cid = cids[0]
+            vol = victim.volumes.volume_of_cid(cid)
+            path = vol.containers._sealed_path(cid)
+            raw = bytearray(open(path, "rb").read())
+            raw[max(16, len(raw) // 2)] ^= 0xFF
+            with open(path, "wb") as f:
+                f.write(raw)
+            # drop the decoded-container LRU so the scrub read hits disk
+            with vol.containers._cache_lock:
+                vol.containers._cache.clear()
+
+            c0 = _S.counter("scrub_corrupt|class=container")
+            r0 = _S.counter("scrub_repairs_triggered")
+            _cycle(victim)
+            assert _S.counter("scrub_corrupt|class=container") > c0
+            assert _S.counter("scrub_repairs_triggered") > r0
+            # quarantined: renamed aside, out of the store's accounting
+            assert os.path.exists(path + QUAR_SUFFIX)
+            assert cid not in victim.containers.container_ids()
+            # the bad location was dropped and re-replicated from the
+            # healthy peer; the repaired read is bit-identical
+            mc.wait_for_replication("/scrub/a", 2)
+            with mc.client("it") as c:
+                assert c.read("/scrub/a") == d
+
+            # surfacing: /prom family, /health verdict, cluster census
+            gw = HttpGateway(mc.namenode.addr).start()
+            try:
+                base = f"http://{gw.addr[0]}:{gw.addr[1]}"
+                prom = _get(base + "/prom").decode()
+                assert any(
+                    line.startswith("hdrf_scrub_corrupt_total{")
+                    and 'class="container"' in line
+                    for line in prom.splitlines())
+                assert 'registry="scrub"' in prom
+                _wait(lambda: json.loads(_get(base + "/health"))
+                      ["scrub_corrupt_total"] > 0,
+                      msg="heartbeat scrub census aggregation")
+                health = json.loads(_get(base + "/health"))
+                assert health["status"] == "degraded"
+                assert health["scrub_repairs_triggered"] > 0
+            finally:
+                gw.stop()
+
+    def test_dangling_reduced_replica_detected(self):
+        """A reduced replica is 0 stored bytes backed by index entries; a
+        lost entry makes it unreconstructable — scrub flags it, bad_block
+        drops the location, re-replication restores the data."""
+        with MiniCluster(n_datanodes=3, replication=2) as mc:
+            d = blob(2, 64_000)
+            with mc.client("it") as c:
+                c.write("/scrub/r", d, scheme="dedup")
+            victim = _holder(mc)
+            bid = victim.replicas.block_ids()[0]
+            # simulate index loss without the replica file going with it
+            victim.index.delete_block(bid)
+            c0 = _S.counter("scrub_corrupt|class=replica")
+            _cycle(victim)
+            assert _S.counter("scrub_corrupt|class=replica") > c0
+            mc.wait_for_replication("/scrub/r", 2)
+            with mc.client("it") as c:
+                assert c.read("/scrub/r") == d
+
+    def test_direct_replica_bitrot_deep_verify(self):
+        """The rotating deep verify catches bit-rot in a direct replica's
+        stored bytes against its finalize-time CRCs."""
+        with MiniCluster(n_datanodes=3, replication=2) as mc:
+            d = blob(5, 64_000)
+            with mc.client("it") as c:
+                c.write("/scrub/d", d, scheme="direct")
+            victim = _holder(mc)
+            bid = victim.replicas.block_ids()[0]
+            path = victim.replicas.data_path(bid)
+            raw = bytearray(open(path, "rb").read())
+            raw[len(raw) // 3] ^= 0x40
+            with open(path, "wb") as f:
+                f.write(raw)
+            c0 = _S.counter("scrub_corrupt|class=replica")
+            _cycle(victim)  # one replica held -> the cursor lands on it
+            assert _S.counter("scrub_corrupt|class=replica") > c0
+            mc.wait_for_replication("/scrub/d", 2)
+            with mc.client("it") as c:
+                assert c.read("/scrub/d") == d
+
+
+# ------------------------------------------------------------ garbage census
+
+
+class TestGarbageCensus:
+    def test_dead_chunk_census_exact_after_delete(self):
+        """Zero-refcount accounting is EXACT: deleting one of two
+        non-overlapping dedup blocks leaves precisely its chunk bytes as
+        dead payload (container payload minus live index bytes)."""
+        with MiniCluster(n_datanodes=1, replication=1) as mc:
+            a, b = blob(7, 50_000), blob(8, 30_000)
+            dn = mc.datanodes[0]
+            with mc.client("g") as c:
+                c.write("/g/a", a, scheme="dedup")
+                bids_a = set(dn.index.block_ids())
+                c.write("/g/b", b, scheme="dedup")
+                bid_b = (set(dn.index.block_ids()) - bids_a).pop()
+                census = _cycle(dn)
+                assert census["dead_chunks"] == 0
+                c.delete("/g/b")
+                _wait(lambda: dn.index.get_block(bid_b) is None,
+                      msg="delete propagation to the chunk index")
+                census = _cycle(dn)
+                assert census["dead_chunks"] == len(b)
+                assert census["orphan_append"] == 0
+                assert c.read("/g/a") == a
+
+    def test_orphan_loser_bytes_census(self):
+        """A dedup-race loser (commit_block returns the fingerprint, its
+        appended bytes stay orphaned in the container) is attributed per
+        container and censused as orphan_append, not dead_chunks."""
+        with MiniCluster(n_datanodes=1, replication=1) as mc:
+            dn = mc.datanodes[0]
+            with mc.client("g") as c:
+                c.write("/g/o", blob(11, 40_000), scheme="dedup")
+            bid = dn.index.block_ids()[0]
+            h = dn.index.get_block(bid).hashes[0]
+            loc = dn.index.chunk_location(h)
+            cid = loc.container_id
+            # the losing racer appended its copy of the chunk before the
+            # index commit decided the race
+            n = 4096
+            end = Scrubber._payload_size(
+                dn.volumes.volume_of_cid(cid).containers, cid)
+            with open(dn.volumes.volume_of_cid(cid).containers
+                      ._raw_path(cid), "ab") as f:
+                f.write(b"\x5c" * n)
+            losers = dn.index.commit_block(9_999_999, n, [h],
+                                           {h: (cid, end, n)})
+            assert losers == [h]
+            assert dn.index.orphan_bytes().get(cid) == n
+            census = _cycle(dn)
+            assert census["orphan_append"] == n
+            assert census["dead_chunks"] == 0
+
+    def test_tmp_reclaim_survives_restart(self):
+        """Satellite 1: tmp+fsync+replace residue from a crashed seal /
+        stripe put / segment put is reclaimed once aged — including
+        orphans found after a DN restart (the crash shape) — while young
+        tmp files are left for their writers and censused."""
+        with MiniCluster(n_datanodes=1, replication=1,
+                         dn_config_overrides={"scrub_tmp_age_s": 30.0}) \
+                as mc:
+            dn = mc.datanodes[0]
+            with mc.client("t") as c:
+                c.write("/t/a", blob(13, 20_000), scheme="dedup")
+            old = time.time() - 3600
+            aged = [
+                os.path.join(dn.volumes.volumes[0].containers._dir,
+                             "999.sealed.tmp"),
+                os.path.join(dn.ec.store._dir, "dn-0.999.0.stripe.tmp"),
+                os.path.join(dn.mirror._store._root, "999.0.seg.tmp"),
+            ]
+            for p in aged:
+                with open(p, "wb") as f:
+                    f.write(b"\x00" * 2048)
+                os.utime(p, (old, old))
+            young = os.path.join(dn.volumes.volumes[0].containers._dir,
+                                 "998.sealed.tmp")
+            with open(young, "wb") as f:
+                f.write(b"\x00" * 512)
+            # the crash: the writer died before the os.replace barrier
+            mc.stop_datanode(0)
+            dn = mc.restart_datanode(0)
+            mc.wait_for_datanodes(1)
+            r0 = _S.counter("scrub_tmp_reclaimed")
+            b0 = _S.counter("scrub_tmp_reclaimed_bytes")
+            census = _cycle(dn)
+            assert _S.counter("scrub_tmp_reclaimed") == r0 + 3
+            assert _S.counter("scrub_tmp_reclaimed_bytes") == b0 + 3 * 2048
+            assert not any(os.path.exists(p) for p in aged)
+            assert os.path.exists(young)
+            assert census["tmp"] == 512
+
+    def test_mirror_segment_reclaim_and_census(self):
+        """Satellite 2: segments shadowed by a full local replica are
+        dropped by the census; segments with no replica behind them are
+        censused as garbage until their upgrade lands."""
+        with MiniCluster(n_datanodes=1, replication=1) as mc:
+            dn = mc.datanodes[0]
+            with mc.client("m") as c:
+                c.write("/m/a", blob(17, 24_000), scheme="direct")
+            bid = dn.replicas.block_ids()[0]
+            dn.mirror._store.put(bid, 0, {"v": 1}, b"z" * 2048)
+            orphan_bid = 424_242
+            dn.mirror._store.put(orphan_bid, 0, {"v": 1}, b"z" * 2048)
+            orphan_path = os.path.join(dn.mirror._store._root,
+                                       f"{orphan_bid}.0.seg")
+            rec0 = metrics.registry("mirror").counter("reconciliations")
+            census = _cycle(dn)
+            # shadowed segment reconciled away; the orphan one censused
+            assert bid not in dn.mirror._store.blocks()
+            assert metrics.registry("mirror").counter(
+                "reconciliations") > rec0
+            assert census["mirror_segments"] == os.path.getsize(orphan_path)
+
+
+# ----------------------------------------------------------- EC stripe scrub
+
+
+@pytest.fixture
+def ec_cluster():
+    with MiniCluster(n_datanodes=5, block_size=256 * 1024,
+                     container_size=32 * 1024) as mc:
+        mc.namenode.config.ec_data_shards = 3
+        mc.namenode.config.ec_parity_shards = 2
+        mc.namenode.config.ec_demote_after_s = 0.0
+        yield mc
+
+
+def _owner_dn(mc):
+    for dn in mc.datanodes:
+        if dn is not None and dn.index.stripe_manifests():
+            return dn
+    return None
+
+
+def _demote(mc, c, path: str, data: bytes):
+    c.write(path, data, scheme="dedup_lz4")
+    mc.namenode.config.ec_demote_after_s = 0.3
+    time.sleep(0.3)
+    _wait(lambda: c._call("ec_status")["demoted_blocks"] >= 1,
+          msg="block demotion")
+    _wait(lambda: c._call("ec_status")["striped_containers"] >= 1,
+          msg="striped-container census")
+
+
+class TestStripeScrub:
+    def test_owner_local_stripe_repair(self, ec_cluster):
+        """A CRC-failing stripe on the manifest OWNER is quarantined and
+        repaired locally (any-k re-decode with ourselves as the target) —
+        no NN round trip, data stays bit-identical."""
+        mc = ec_cluster
+        data = blob(21, 200_000)
+        with mc.client("ec") as c:
+            _demote(mc, c, "/cold/own", data)
+            owner = _owner_dn(mc)
+            assert owner is not None
+            stripe_seen = []
+            fault_injection.install(
+                "scrub.stripe", lambda **kw: stripe_seen.append(kw))
+            own = [s for s in owner.ec.store.iter_stripes()
+                   if s[0] == owner.dn_id]
+            assert own
+            _, cid, idx, _nb = own[0]
+            path = os.path.join(owner.ec.store._dir,
+                                f"{owner.dn_id}.{cid}.{idx}.stripe")
+            raw = bytearray(open(path, "rb").read())
+            raw[len(raw) // 2] ^= 0xFF
+            with open(path, "wb") as f:
+                f.write(raw)
+            c0 = _S.counter("scrub_corrupt|class=stripe")
+            r0 = _S.counter("scrub_repairs_triggered")
+            d0 = _S.counter("scrub_decode_checks")
+            _cycle(owner)
+            assert stripe_seen
+            assert _S.counter("scrub_corrupt|class=stripe") > c0
+            assert _S.counter("scrub_repairs_triggered") > r0
+            assert _S.counter("scrub_decode_checks") > d0
+            assert os.path.exists(path + QUAR_SUFFIX)
+            # local repair re-decoded and rewrote the stripe in place
+            _wait(lambda: os.path.exists(path), msg="local stripe repair")
+            assert c.read("/cold/own") == data
+
+    def test_foreign_stripe_reports_bad_stripe_and_monitor_repairs(
+            self, ec_cluster):
+        """A corrupt stripe on a NON-owner (no local manifest): first scrub
+        records the CRC baseline, the second detects the flip, reports
+        ``bad_stripe`` to the NN, and the stripe-repair monitor schedules
+        the owner's re-decode."""
+        mc = ec_cluster
+        data = blob(23, 200_000)
+        with mc.client("ec") as c:
+            _demote(mc, c, "/cold/foreign", data)
+            owner = _owner_dn(mc)
+            cid, man = next(iter(owner.index.stripe_manifests().items()))
+            fidx, f_dnid = next(
+                (i, h[0]) for i, h in enumerate(man["holders"])
+                if h[0] != owner.dn_id)
+            fdn = mc.datanodes[int(f_dnid.split("-")[1])]
+            _cycle(fdn)  # baseline CRC for the foreign stripe
+            path = os.path.join(fdn.ec.store._dir,
+                                f"{owner.dn_id}.{cid}.{fidx}.stripe")
+            raw = bytearray(open(path, "rb").read())
+            raw[len(raw) // 2] ^= 0xFF
+            with open(path, "wb") as f:
+                f.write(raw)
+            c0 = _S.counter("scrub_corrupt|class=stripe")
+            n0 = _NN.counter("corrupt_stripes_reported")
+            rep0 = _EC.counter("stripes_repaired")
+            _cycle(fdn)
+            assert _S.counter("scrub_corrupt|class=stripe") > c0
+            assert _NN.counter("corrupt_stripes_reported") > n0
+            assert os.path.exists(path + QUAR_SUFFIX)
+            _wait(lambda: _EC.counter("stripes_repaired") > rep0,
+                  timeout=25.0, msg="monitor-scheduled stripe repair")
+            assert c.read("/cold/foreign") == data
+
+
+# ------------------------------------------------------- NN invariant audit
+
+
+class TestFsck:
+    def test_missing_extra_surfaced_on_every_plane(self):
+        """The invariant census classes surface identically through
+        rpc_fsck, ``dfsadmin -fsck``, the gateway's /fsck and the /health
+        degraded verdict."""
+        with MiniCluster(n_datanodes=2, replication=1) as mc:
+            d = blob(3, 60_000)
+            with mc.client("f") as c:
+                c.write("/f/a", d, scheme="direct")
+                holder = _holder(mc)
+                hidx = int(holder.dn_id.split("-")[1])
+
+                # extra: a DN claims a block the map never had
+                nn = mc.namenode
+                live_dn = next(iter(nn._datanodes))
+                nn._datanodes[live_dn].blocks.add(987_654_321)
+                fs = nn.rpc_fsck()
+                assert fs["counts"]["extra"] >= 1 and not fs["healthy"]
+                nn._datanodes[live_dn].blocks.discard(987_654_321)
+                fs = nn.rpc_fsck()
+                assert fs["counts"]["extra"] == 0
+
+                # missing: kill the only holder; no byte source remains
+                mc.kill_datanode(hidx)
+                _wait(lambda: c._call("fsck")["counts"]["missing"] >= 1,
+                      timeout=10.0, msg="missing-block detection")
+                fs = c._call("fsck")
+                assert not fs["healthy"] and fs["violations"] >= 1
+
+                # monitor pass exports the gauges
+                _wait(lambda: _NN.snapshot()["gauges"]
+                      .get("fsck_violations", 0) >= 1,
+                      msg="fsck monitor gauge")
+                assert _NN.snapshot()["gauges"].get("fsck_missing", 0) >= 1
+
+            nn_addr = f"{mc.namenode.addr[0]}:{mc.namenode.addr[1]}"
+            rc, out = run_cli(["dfsadmin", "--namenode", nn_addr, "-fsck"])
+            assert rc == 0
+            doc = json.loads(out)
+            assert doc["counts"]["missing"] >= 1
+
+            gw = HttpGateway(mc.namenode.addr).start()
+            try:
+                base = f"http://{gw.addr[0]}:{gw.addr[1]}"
+                gfs = json.loads(_get(base + "/fsck"))
+                assert gfs["counts"]["missing"] >= 1
+                health = json.loads(_get(base + "/health"))
+                assert health["status"] == "degraded"
+                assert health["fsck_violations"] >= 1
+            finally:
+                gw.stop()

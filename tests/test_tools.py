@@ -154,6 +154,19 @@ class TestParityCitations:
         problems = check_parity.check_bench_contract(root, key="mirror")
         assert not problems, "\n".join(problems)
 
+    def test_bench_scrub_block_in_both_json_branches(self):
+        """Same contract for the integrity-scrub summary block: the
+        bytes_verified / corrupt_total / garbage_bytes numbers
+        (server/scrubber.py) must be a literal key in BOTH json.dumps
+        branches of bench.py — and the output must stay exactly one JSON
+        line."""
+        import hdrf_tpu
+        from hdrf_tpu.tools import check_parity
+
+        root = os.path.dirname(os.path.abspath(hdrf_tpu.__file__))
+        problems = check_parity.check_bench_contract(root, key="scrub")
+        assert not problems, "\n".join(problems)
+
 
 class TestOfflineViewers:
     def test_oiv_oev(self, cluster, tmp_path):
