@@ -43,10 +43,16 @@ Prints ONE JSON line:
           "storage_ratio"},                    # EC cold-tier stamp
                                                # (storage/stripe_store.py)
    "read": {"read_amplification", "cache_hit_ratio", "read_p95_ms",
-            "tenant_count"}}                   # read-plane stamp over the
-                                               # product reconstruct path
-                                               # (HDRF_BENCH_READ_MOSTLY=1
-                                               # scales the replay rounds)
+            "tenant_count",
+            "chunk_cache_hit_ratio", "read_batches",
+            "containers_decoded_per_read"}}    # read-plane stamp over the
+                                               # product reconstruct path +
+                                               # serving engine
+                                               # (server/read_plane.py);
+                                               # HDRF_BENCH_READ_MOSTLY=1
+                                               # scales the replay rounds
+                                               # and interleaves writes
+                                               # (mixed read/write profile)
 """
 
 from __future__ import annotations
@@ -75,12 +81,14 @@ if os.environ.get("HDRF_BENCH_SMOKE") == "1":
     BLOCK_MB, N_BLOCKS, SUB_BATCHES, CPU_MB = 1, 2, 2, 1
     E2E_BLOCKS = TG_BLOCKS = 2
 
+READ_MOSTLY = os.environ.get("HDRF_BENCH_READ_MOSTLY") == "1"
 READ_ROUNDS = 3
-if os.environ.get("HDRF_BENCH_READ_MOSTLY") == "1":
+if READ_MOSTLY:
     # Read-mostly profile (same pattern as HDRF_BENCH_SMOKE): the read
-    # stamp replays its corpus many more times, so the cache-hit ratio and
-    # read-amplification numbers reflect a serving-heavy DataNode instead
-    # of a write-dominated one.
+    # stamp replays its corpus many more times — and interleaves fresh
+    # dedup commits between replay rounds (a mixed read/write scenario) —
+    # so the cache-hit ratio and read-amplification numbers reflect a
+    # serving-heavy DataNode instead of a write-dominated one.
     READ_ROUNDS = 16
 
 
@@ -362,11 +370,18 @@ def _read_summary(tmp: str) -> dict:
     read timeline (utils/profiler.py read_timeline), so the same
     index_lookup / container_decode phases, decoded-container LRU, and
     read-amplification counters the DataNode serves /prom from are what
-    this stamp reports.  ``HDRF_BENCH_READ_MOSTLY=1`` raises the replay
-    count (read-mostly profile).  Keys: read_amplification (physical
+    this stamp reports.  Reads route through the chunk-granular serving
+    engine (server/read_plane.py — decoded-chunk cache + grouped decode
+    dispatch), exactly as a DataNode wires it.  ``HDRF_BENCH_READ_MOSTLY=1``
+    raises the replay count AND interleaves fresh dedup commits between
+    rounds (mixed read/write profile).  Keys: read_amplification (physical
     decoded / logical served for the exercised scheme), cache_hit_ratio
     (decoded-container LRU), read_p95_ms (read_wall_us histogram),
-    tenant_count (utils/tenants.py — the bench reads as its own tenant)."""
+    tenant_count (utils/tenants.py — the bench reads as its own tenant),
+    chunk_cache_hit_ratio (decoded-CHUNK cache, this run's probes),
+    read_batches (grouped decode dispatches: coalesced batches + inline
+    groups), containers_decoded_per_read (mean decode fan-out per plan —
+    the read-amplification acceptance gauge)."""
     import time as _time
 
     from hdrf_tpu import native
@@ -376,6 +391,7 @@ def _read_summary(tmp: str) -> dict:
     from hdrf_tpu.reduction import accounting
     from hdrf_tpu.reduction import scheme as schemes
     from hdrf_tpu.reduction.dedup import dedup_commit
+    from hdrf_tpu.server import read_plane
     from hdrf_tpu.storage import container_store
     from hdrf_tpu.storage.container_store import ContainerStore
     from hdrf_tpu.utils import metrics, profiler, tenants
@@ -401,9 +417,31 @@ def _read_summary(tmp: str) -> dict:
                      on_seal=index.seal_container)
     containers.flush_open(on_seal=index.seal_container)
     scheme = schemes.get("dedup_lz4")
+    rp = read_plane.ReadPlane(containers, window_ms=0, backend="native")
+    rp.attach_store(containers)
     ctx = schemes.ReductionContext(config=ReductionConfig(),
-                                   containers=containers, index=index)
-    for _ in range(READ_ROUNDS):
+                                   containers=containers, index=index,
+                                   read_plane=rp)
+    rpm = metrics.registry("read_plane")
+    base = {k: rpm.counter(k) for k in
+            ("chunk_cache_hit", "chunk_cache_miss", "read_batches",
+             "inline_decodes", "containers_fetched", "plans_served")}
+    for rnd in range(READ_ROUNDS):
+        if READ_MOSTLY and rnd % 4 == 3:
+            # mixed read/write: a fresh half-duplicate block lands between
+            # replay rounds, churning the open lane and the chunk cache
+            nb = _make_block(1, seed=910 + rnd)
+            nb[: nb.size // 2] = np.frombuffer(blocks[0],
+                                               np.uint8)[: nb.size // 2]
+            data = nb.tobytes()
+            buf = np.frombuffer(data, np.uint8)
+            cuts = native.cdc_chunk(buf, mask, cdc.min_chunk, cdc.max_chunk)
+            starts = np.concatenate([[0], cuts[:-1]]).astype(np.uint64)
+            digs = native.sha256_batch(buf, starts,
+                                       (cuts - starts).astype(np.uint64))
+            dedup_commit(len(blocks), data, cuts, digs, index, containers,
+                         on_seal=index.seal_container)
+            blocks.append(data)
         for bid, data in enumerate(blocks):
             t0 = _time.perf_counter()
             with profiler.read_timeline(bid, nbytes=len(data)):
@@ -411,7 +449,10 @@ def _read_summary(tmp: str) -> dict:
             assert out == data, "read-path stamp diverged from the corpus"
             tenants.note_op("bench-reader", "read", len(data),
                             latency_s=_time.perf_counter() - t0)
+    rp.close()
     index.close()
+    d_ = {k: rpm.counter(k) - v for k, v in base.items()}
+    probes = d_["chunk_cache_hit"] + d_["chunk_cache_miss"]
     amp = accounting.read_amplification_report().get(scheme.name, {})
     reg = metrics.registry("read_profiler")
     with reg._lock:
@@ -422,6 +463,12 @@ def _read_summary(tmp: str) -> dict:
         "cache_hit_ratio": round(container_store.cache_hit_ratio(), 4),
         "read_p95_ms": round(float(p95) / 1e3, 3),
         "tenant_count": tenants.tenant_count(),
+        "chunk_cache_hit_ratio": round(
+            d_["chunk_cache_hit"] / probes if probes else 0.0, 4),
+        "read_batches": d_["read_batches"] + d_["inline_decodes"],
+        "containers_decoded_per_read": round(
+            d_["containers_fetched"] / d_["plans_served"]
+            if d_["plans_served"] else 0.0, 4),
     }
 
 

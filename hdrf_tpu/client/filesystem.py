@@ -22,13 +22,14 @@ client:
 from __future__ import annotations
 
 import socket
+import time
 import uuid
 
 from hdrf_tpu import native
 from hdrf_tpu.config import ClientConfig
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import RpcClient, recv_frame
-from hdrf_tpu.utils import metrics, retry, tracing
+from hdrf_tpu.utils import metrics, retry, rollwin, tracing
 
 _M = metrics.registry("client")
 _TR = tracing.tracer("client")
@@ -55,6 +56,10 @@ class HdrfClient:
         self._nn = (HaRpcClient(addrs) if len(addrs) > 1
                     else RpcClient(addrs[0]))
         self._sc_cache = None  # lazy ShortCircuitCache (fd + shm slots)
+        # Rolling window of successful block-read latencies: its p95 sets
+        # the hedged-read trigger (utils/rollwin.py, the same discipline
+        # as the mirror plane's per-peer hedge windows).
+        self._read_lat = rollwin.RollingWindow(window_s=300.0, maxlen=128)
         self._dtoken: dict | None = None
         if self.config.use_delegation_tokens:
             self._dtoken = self._nn.call("get_delegation_token",
@@ -577,6 +582,8 @@ class HdrfClient:
                     if data is not None:
                         _M.incr("short_circuit_reads")
                         return data
+        if self.config.hedged_reads and len(locations) > 1:
+            return self._read_hedged(binfo, locations, offset, length)
         last_err: Exception | None = None
         for loc in locations:  # failover across replicas
             try:
@@ -588,6 +595,45 @@ class HdrfClient:
                 _M.incr("read_failovers")
         raise IOError(f"all {len(locations)} locations failed for block "
                       f"{binfo['block_id']}: {last_err}")
+
+    def _read_hedged(self, binfo: dict, locations: list, offset: int,
+                     length: int) -> bytes:
+        """Tied-request replica reads (the reference's hedged-read pool,
+        DFSInputStream.java:1131 hedgedFetchBlockByteRange, rebuilt on
+        utils/retry.hedged_quorum): the first location is the primary leg;
+        the rest launch once the primary exceeds the rolling-p95 latency
+        deadline (ClientConfig.read_hedge_p95_mult over the client's block-
+        read window) — or immediately on primary failure, preserving the
+        serial loop's fail-fast failover."""
+        def leg(loc):
+            def run():
+                t0 = time.monotonic()
+                data = self._read_from(tuple(loc["addr"]),
+                                       binfo["block_id"], offset, length,
+                                       token=binfo.get("token"))
+                self._read_lat.add(time.monotonic() - t0)
+                return data
+            return run
+
+        s = self._read_lat.summary()
+        hedge_after = max(
+            (s["p95"] if s else 0.0) * self.config.read_hedge_p95_mult,
+            self.config.read_hedge_floor_s)
+        try:
+            wins, errors, _hedged = retry.hedged_quorum(
+                [leg(locations[0])], [leg(l) for l in locations[1:]],
+                k=1, hedge_after_s=hedge_after,
+                on_hedge=lambda: _M.incr("read_hedges_fired"))
+        except retry.QuorumFailed as e:
+            _M.incr("read_failovers", len(locations))
+            raise IOError(f"all {len(locations)} locations failed for block "
+                          f"{binfo['block_id']}: {e}") from e
+        if errors:
+            _M.incr("read_failovers", len(errors))
+        idx, data = wins[0]
+        if idx >= 1:  # a hedge leg answered first (leg 0 is the primary)
+            _M.incr("read_hedge_wins")
+        return data
 
     # ------------------------------------------------------- file checksum
 

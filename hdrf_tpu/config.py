@@ -156,6 +156,20 @@ class ReductionConfig:
     # windows have no samples yet, and as a lower bound so a cold window
     # never hedges at ~0 s.
     mirror_hedge_floor_s: float = 0.25
+    # Read plane (server/read_plane.py): byte budget of the DN-wide
+    # decoded-chunk cache, keyed by fingerprint so hits serve cross-file
+    # as far as dedup reached.  0 disables the cache (plans still resolve
+    # chunk-granular).
+    chunk_cache_mb: float = 8.0
+    # Read coalescer window (ms): concurrent readers' container-decode
+    # misses arriving within the window decode through one batched
+    # dispatch.  Only armed on the TPU backend with read_max_inflight > 1;
+    # 0 decodes inline on the reader's thread (today's serial behavior).
+    read_batch_window_ms: float = 2.0
+    # Admission bound on plans simultaneously inside the read plane's
+    # fetch stage (the read-side sibling of pipeline_max_inflight; the
+    # DN-level max_concurrent_reads gate still applies outside it).
+    read_max_inflight: int = 16
     cdc: CdcConfig = field(default_factory=CdcConfig)
 
 
@@ -341,6 +355,16 @@ class ClientConfig:
     # None = no client-imposed budget (default: the dev VM's write-burst
     # throttling stalls ~35 s, so budgets are strictly opt-in).
     op_deadline_s: float | None = None
+    # Hedged replica reads (utils/retry.hedged_quorum): when a block has
+    # >1 location, the second location launches as a tied request once the
+    # first exceeds (rolling-window p95 block-read latency) * mult, or
+    # immediately on primary failure.  False restores the serial failover
+    # loop verbatim.
+    hedged_reads: bool = True
+    read_hedge_p95_mult: float = 3.0
+    # Hedge-delay floor/fallback (s): used before the latency window has
+    # samples, and as a lower bound so a cold window never hedges at ~0 s.
+    read_hedge_floor_s: float = 0.05
 
 
 @dataclass

@@ -36,6 +36,7 @@ import numpy as np
 from hdrf_tpu.ops import dispatch
 from hdrf_tpu.reduction import accounting, scheme as scheme_mod
 from hdrf_tpu.reduction.scheme import ReductionContext, ReductionScheme
+from hdrf_tpu.server import read_plane as read_plane_mod
 from hdrf_tpu.utils import fault_injection, metrics, profiler, tracing
 
 _M = metrics.registry("dedup")
@@ -288,54 +289,43 @@ class DedupScheme(ReductionScheme):
 
     def reconstruct(self, block_id: int, stored: bytes, logical_len: int,
                     ctx: ReductionContext, offset: int = 0,
-                    length: int = -1) -> bytes:
+                    length: int = -1, plan=None) -> bytes:
+        """Chunk-granular range read.  ``plan`` is a pre-resolved
+        read_plane.ChunkPlan (the serving engine resolves once per request
+        and threads it through); None resolves here — same index walk,
+        same result."""
         assert ctx.index is not None and ctx.containers is not None
-        with profiler.phase("index_lookup"):
-            entry = ctx.index.get_block(block_id)
-            if entry is None:
-                raise KeyError(f"block {block_id} not in chunk index")
-            end = entry.logical_len if length < 0 else min(offset + length,
-                                                           entry.logical_len)
-            if offset >= end:
-                return b""
-            locmap = ctx.index.lookup_chunks(list(set(entry.hashes)))
-            # Chunk-granular range selection over the logical layout.
-            out = bytearray(end - offset)
-            pos = 0
-            wanted: list[tuple[int, int, int]] = []  # (cid, off, len) per needed chunk
-            spans: list[tuple[int, int, int]] = []   # (out_at, src_from, n)
-            for h in entry.hashes:
-                loc = locmap[h]
-                if loc is None:
-                    raise IOError(f"block {block_id}: chunk {h.hex()} missing from index")
-                c_start, c_len = pos, loc.length
-                pos += c_len
-                if c_start >= end or c_start + c_len <= offset:
-                    continue
-                lo = max(offset, c_start) - c_start
-                hi = min(end, c_start + c_len) - c_start
-                wanted.append((loc.container_id, loc.offset, loc.length))
-                spans.append((max(offset, c_start) - offset, lo, hi - lo))
-            if pos != entry.logical_len:
-                raise IOError(f"block {block_id}: chunk lengths sum to {pos}, "
-                              f"index says {entry.logical_len}")
-        accounting.record_read_logical(self.name, end - offset)
+        if plan is None:
+            with profiler.phase("index_lookup"):
+                plan = read_plane_mod.resolve_chunk_plan(ctx.index, block_id,
+                                                         offset, length)
+        if plan.out_len == 0:
+            return b""
+        out = bytearray(plan.out_len)
+        accounting.record_read_logical(self.name, plan.out_len)
         with accounting.read_scope(self.name):
-            if ctx.recon is not None and end - offset >= DEVICE_RECON_MIN:
+            if ctx.recon is not None and plan.out_len >= DEVICE_RECON_MIN:
                 # device read path (DataConstructor -> "Pallas gather" per
                 # SURVEY §2.1): chunks gather from HBM-resident container
                 # images; host pays one ordered copy pass
                 with profiler.phase("container_decode"):
                     ctx.recon.gather(
-                        wanted,
+                        plan.wanted,
                         lambda cid: ctx.containers.read_container(cid),
-                        spans, out)
+                        plan.spans, out)
                 _M.incr("blocks_reconstructed_device")
                 return bytes(out)
-            with profiler.phase("container_decode"):
-                chunks = ctx.containers.read_chunks(wanted)
-                for chunk, (out_at, lo, n) in zip(chunks, spans):
+            if ctx.read_plane is not None:
+                # shared decoded-chunk cache + coalesced container decodes
+                # (the coalescer records its own container_decode spans)
+                chunks = ctx.read_plane.fetch_chunks(plan)
+                for chunk, (out_at, lo, n) in zip(chunks, plan.spans):
                     out[out_at:out_at + n] = chunk[lo:lo + n]
+            else:
+                with profiler.phase("container_decode"):
+                    chunks = ctx.containers.read_chunks(plan.wanted)
+                    for chunk, (out_at, lo, n) in zip(chunks, plan.spans):
+                        out[out_at:out_at + n] = chunk[lo:lo + n]
         _M.incr("blocks_reconstructed")
         return bytes(out)
 

@@ -52,6 +52,12 @@ class ReductionContext:
     # reconstruction-heavy reads gather chunks from HBM-resident container
     # images instead of host memory.
     recon: object | None = None
+    # Chunk-granular serving engine (server/read_plane.ReadPlane): when
+    # set, dedup reconstruction serves chunk misses through its shared
+    # decoded-chunk cache + read coalescer instead of per-read
+    # read_chunks.  None keeps the direct container-store path (bench
+    # micro-harnesses, tests).
+    read_plane: object | None = None
 
 
 class ReductionScheme(ABC):

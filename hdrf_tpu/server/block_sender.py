@@ -30,22 +30,31 @@ if TYPE_CHECKING:
 _M = metrics.registry("block_sender")
 _TR = tracing.tracer("datanode")
 
+# sentinel: "resolve the meta yourself" (None is a real value — PROVIDED
+# blocks have no local BlockMeta)
+_UNRESOLVED = object()
+
 
 class BlockSender:
     def __init__(self, dn: "DataNode"):
         self._dn = dn
 
     def read_logical(self, block_id: int, offset: int = 0,
-                     length: int = -1) -> bytes:
-        """Logical bytes of a block, whatever its stored form."""
+                     length: int = -1, meta=_UNRESOLVED) -> bytes:
+        """Logical bytes of a block, whatever its stored form.  ``meta``
+        threads an already-resolved BlockMeta (or None for a PROVIDED
+        block) through from serve_read so the replica index is probed once
+        per request — the double get_meta used to book a second
+        ``index_lookup`` span per read."""
         dn = self._dn
         with profiler.phase("cache_probe"):
             cached = dn.cache.get(block_id, offset, length)
         if cached is not None:
             _M.incr("cached_reads")
             return cached  # pinned logical bytes: no disk, no reconstruction
-        with profiler.phase("index_lookup"):
-            meta = dn.replicas.get_meta(block_id)
+        if meta is _UNRESOLVED:
+            with profiler.phase("index_lookup"):
+                meta = dn.replicas.get_meta(block_id)
         if meta is None:
             # PROVIDED replica: bytes live in the external store the alias
             # map points at (FileRegion -> ProvidedStorageLocation)
@@ -89,7 +98,8 @@ class BlockSender:
                     if meta is None and region is None:
                         raise KeyError(
                             f"block {block_id} not on this datanode")
-                    data = self.read_logical(block_id, offset, length)
+                    data = self.read_logical(block_id, offset, length,
+                                             meta=meta)
                     tl.nbytes = len(data)
             except Exception as e:  # noqa: BLE001 — status crosses the wire
                 send_frame(sock, {"status": 1, "error": type(e).__name__,

@@ -239,6 +239,18 @@ class DataNode:
         self.reduction_ctx = ReductionContext(
             config=red, containers=self.containers, index=self.index,
             backend=backend, worker=self._worker, recon=recon)
+        # Chunk-granular serving engine (server/read_plane.py): shared
+        # decoded-chunk cache + coalesced container decodes.  The retire
+        # hook drops cached chunks when a container is quarantined or
+        # deleted (scrubber/compaction interplay).
+        from hdrf_tpu.server.read_plane import ReadPlane
+
+        self.read_plane = ReadPlane(
+            self.containers, chunk_cache_mb=red.chunk_cache_mb,
+            window_ms=red.read_batch_window_ms,
+            max_inflight=red.read_max_inflight, backend=backend)
+        self.read_plane.attach_store(self.containers)
+        self.reduction_ctx.read_plane = self.read_plane
         # EC cold tier (server/ec_tier.py): stripe store + demote/serve/
         # repair roles; installs the degraded-read fallback hooks on the
         # container stores (AFTER the recon _on_delete wiring above — the
@@ -476,6 +488,7 @@ class DataNode:
             t.join(timeout=5)
         if self.write_pipeline is not None:
             self.write_pipeline.close()   # before flush: no new dispatches
+        self.read_plane.close()           # drain the coalescer's worker
         self.containers.flush_open(on_seal=self.index.seal_container)
         if hasattr(self.containers, "close_async_seals"):
             self.containers.close_async_seals()
@@ -965,12 +978,16 @@ class DataNode:
 
     def _read_plane_report(self) -> dict:
         """Serving-path aggregate riding heartbeats to /health: decoded-
-        container cache hit ratio, per-scheme read amplification, and the
-        per-tenant rolling SLO summaries (utils/tenants.py)."""
+        container + decoded-chunk cache hit ratios, per-scheme read
+        amplification, and the per-tenant rolling SLO summaries
+        (utils/tenants.py)."""
+        from hdrf_tpu.server import read_plane as read_plane_mod
         from hdrf_tpu.storage import container_store
 
         return {
             "container_cache_hit_ratio": container_store.cache_hit_ratio(),
+            "chunk_cache_hit_ratio": read_plane_mod.chunk_cache_hit_ratio(),
+            "chunk_cache_bytes": self.read_plane.cache.bytes_used,
             "read_amplification": accounting.read_amplification_report(),
             "tenants": tenants.summaries(),
         }
@@ -988,6 +1005,7 @@ class DataNode:
         over-time curve is the honest production story (ROADMAP item 3):
         storage/dedup ratios, cache hit rate, read/write p95, inflight
         ops, breaker states."""
+        from hdrf_tpu.server import read_plane as read_plane_mod
         from hdrf_tpu.storage import container_store
 
         acc = self.index.accounting()
@@ -1004,6 +1022,7 @@ class DataNode:
             "dedup_ratio": accounting.dedup_ratio(
                 acc["logical_bytes"], acc["unique_chunk_bytes"]),
             "container_cache_hit_ratio": container_store.cache_hit_ratio(),
+            "chunk_cache_hit_ratio": read_plane_mod.chunk_cache_hit_ratio(),
             "read_p95_ms": self._hist_quantile_ms("read_profiler",
                                                   "read_wall_us"),
             "write_p95_ms": self._hist_quantile_ms("write_profiler",

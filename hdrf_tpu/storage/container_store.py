@@ -99,6 +99,12 @@ class ContainerStore:
         # observer for container deletion (compaction/GC): lets a device
         # reconstructor drop its stale HBM image
         self._on_delete = None
+        # observer for container RETIREMENT (delete OR quarantine): the
+        # read plane's decoded-chunk cache drops entries sliced from the
+        # container.  Separate from _on_delete because quarantine keeps the
+        # container logically present (no HBM/EC teardown) yet its bytes
+        # must never serve again, cached slices included.
+        self._on_retire = None
         # EC cold tier hooks (storage/stripe_store.py): when a sealed file
         # is gone because the container was demoted to stripes,
         # ``_stripe_fallback(cid)`` returns the reconstructed sealed FILE
@@ -452,8 +458,7 @@ class ContainerStore:
 
     # -------------------------------------------------------------- reading
 
-    def read_container(self, cid: int) -> bytes:
-        """Full uncompressed container bytes (open or sealed)."""
+    def _cache_probe(self, cid: int) -> bytes | None:
         with self._cache_lock:
             if cid in self._cache:
                 _M.incr("cache_hit")
@@ -466,6 +471,11 @@ class ContainerStore:
                 return data
             _M.incr("cache_miss")
         _gauge_hit_ratio()
+        return None
+
+    def _read_undecoded(self, cid: int) -> bytes | None:
+        """Open-lane memory image or raw-file bytes — the no-decompress
+        sources; None when the container is sealed (or gone)."""
         from hdrf_tpu.reduction import accounting  # storage->reduction: leaf-only
 
         for lane in self._lanes:
@@ -487,7 +497,12 @@ class ContainerStore:
                 accounting.record_container_decode(len(data))
                 return data
         except FileNotFoundError:
-            pass
+            return None
+
+    def _sealed_parse(self, cid: int) -> tuple[str, int, bytes]:
+        """(codec name, uncompressed size, compressed payload) of the
+        sealed container — the decode deferred so the read coalescer can
+        run a whole window's payloads through one batched dispatch."""
         try:
             with open(self._sealed_path(cid), "rb") as f:
                 blob = f.read()
@@ -503,16 +518,67 @@ class ContainerStore:
         magic, usize, codec_id = _SEAL_HDR.unpack(blob[:_SEAL_HDR.size])
         if magic != _SEAL_MAGIC:
             raise IOError(f"container {cid}: bad magic {magic:#x}")
-        data = codecs.decompress(codecs.CODEC_NAMES[codec_id],
-                                 blob[_SEAL_HDR.size:], usize)
-        accounting.record_container_decode(len(data))
+        return codecs.CODEC_NAMES[codec_id], usize, blob[_SEAL_HDR.size:]
+
+    def _cache_insert(self, cid: int, data: bytes) -> None:
         with self._cache_lock:
             self._cache.pop(cid, None)  # keep the re-insert most-recent
             self._cache[cid] = data
             while len(self._cache) > self._cache_cap:
                 self._cache.pop(next(iter(self._cache)))
                 _M.incr("cache_evict")
+
+    def read_container(self, cid: int) -> bytes:
+        """Full uncompressed container bytes (open or sealed)."""
+        data = self._cache_probe(cid)
+        if data is not None:
+            return data
+        data = self._read_undecoded(cid)
+        if data is not None:
+            return data
+        codec_name, usize, payload = self._sealed_parse(cid)
+        data = codecs.decompress(codec_name, payload, usize)
+        from hdrf_tpu.reduction import accounting
+
+        accounting.record_container_decode(len(data))
+        self._cache_insert(cid, data)
         return data
+
+    def read_containers(self, cids: list[int],
+                        decompress_batch=None) -> dict[int, bytes]:
+        """Grouped form of ``read_container``: every distinct cid resolved
+        once, and the sealed payloads that actually need decompression run
+        through ONE ``decompress_batch(codec_names, blobs, usizes)`` call
+        (the read coalescer passes ops/dispatch.block_decompress_batch) —
+        the read-side sibling of flush_open's compress_batch_fn grouping.
+        LRU probes, open/raw fast paths and decode accounting are
+        identical to the per-cid path."""
+        out: dict[int, bytes] = {}
+        pending: list[tuple[int, str, int, bytes]] = []
+        for cid in dict.fromkeys(cids):
+            data = self._cache_probe(cid)
+            if data is None:
+                data = self._read_undecoded(cid)
+            if data is not None:
+                out[cid] = data
+                continue
+            codec_name, usize, payload = self._sealed_parse(cid)
+            pending.append((cid, codec_name, usize, payload))
+        if pending:
+            if decompress_batch is not None:
+                datas = decompress_batch([p[1] for p in pending],
+                                         [p[3] for p in pending],
+                                         [p[2] for p in pending])
+            else:
+                datas = [codecs.decompress(c, b, u)
+                         for _, c, u, b in pending]
+            from hdrf_tpu.reduction import accounting
+
+            for (cid, _c, _u, _b), data in zip(pending, datas):
+                accounting.record_container_decode(len(data))
+                self._cache_insert(cid, data)
+                out[cid] = data
+        return out
 
     def read_chunks(self, locs: list[tuple[int, int, int]]) -> list[bytes]:
         """Fetch many chunks, grouping by container so each container is read
@@ -594,6 +660,8 @@ class ContainerStore:
                 continue
         with self._cache_lock:
             self._cache.pop(cid, None)
+        if self._on_retire is not None:
+            self._on_retire(cid)
         return moved
 
     def delete_container(self, cid: int) -> None:
@@ -602,6 +670,8 @@ class ContainerStore:
                 os.unlink(p)
         with self._cache_lock:
             self._cache.pop(cid, None)
+        if self._on_retire is not None:
+            self._on_retire(cid)
         if self._on_delete is not None:
             self._on_delete(cid)
 

@@ -461,6 +461,17 @@ class MultiContainerStore:
                 out[i] = b
         return out
 
+    def read_containers(self, cids, decompress_batch=None):
+        by_vol: dict[int, list[int]] = {}
+        for cid in cids:
+            by_vol.setdefault(cid >> CID_SHIFT, []).append(cid)
+        out: dict[int, bytes] = {}
+        for vid, ids in by_vol.items():
+            vol = self._vs.volume_of_cid(vid << CID_SHIFT)
+            out.update(vol.containers.read_containers(
+                ids, decompress_batch=decompress_batch))
+        return out
+
     def copy_live(self, cid: int, live, on_seal=None):
         # live chunks move into the OWNING volume's open lane (compaction
         # stays intra-volume so cids keep routing correctly)
@@ -525,6 +536,17 @@ class MultiContainerStore:
     def _on_delete(self, fn) -> None:
         for v in self._vs.volumes:
             v.containers._on_delete = fn
+
+    @property
+    def _on_retire(self):
+        return self._vs.volumes[0].containers._on_retire
+
+    @_on_retire.setter
+    def _on_retire(self, fn) -> None:
+        # the decoded-chunk cache is DN-wide (server/read_plane.py), so one
+        # retirement hook covers every volume's store
+        for v in self._vs.volumes:
+            v.containers._on_retire = fn
 
     @property
     def _stripe_fallback(self):

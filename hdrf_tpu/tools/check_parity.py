@@ -146,27 +146,62 @@ def check_prom_metrics(root: str, arch_md: str | None = None) -> list[str]:
     return problems
 
 
+def _value_carries_key(value: ast.expr, sub: str,
+                       funcs: dict[str, ast.FunctionDef]) -> bool:
+    """Does the expression bound to a top-level bench key provably carry
+    ``sub`` as a literal dict key?  Two shapes are recognized: an inline
+    ``{...}`` literal, and a call to a module-level helper (the
+    ``_read_summary(tmp)`` pattern) whose ``return {...}`` literal is
+    scanned one level deep."""
+    dicts: list[ast.Dict] = []
+    if isinstance(value, ast.Dict):
+        dicts.append(value)
+    elif (isinstance(value, ast.Call) and isinstance(value.func, ast.Name)
+            and value.func.id in funcs):
+        for node in ast.walk(funcs[value.func.id]):
+            if isinstance(node, ast.Return) and isinstance(node.value,
+                                                           ast.Dict):
+                dicts.append(node.value)
+    return any(isinstance(k, ast.Constant) and k.value == sub
+               for d in dicts for k in d.keys)
+
+
 def check_bench_contract(root: str, bench_py: str | None = None,
                          key: str = "multichip") -> list[str]:
     """Fourth lint: bench.py's output contract.  The bench emits its one
     JSON line from two branches (native CPU fallback and the TPU path);
     a summary block added to only one silently vanishes from whichever
     backend the driver happens to land on.  Assert the ``key`` appears as
-    a literal dict key in at least two ``json.dumps({...})`` calls."""
+    a literal dict key in at least two ``json.dumps({...})`` calls.
+
+    A dotted key (``read.chunk_cache_hit_ratio``) additionally pins a
+    SUB-key of a summary block: each branch's value for the top key must
+    carry the sub-key, either as an inline dict literal or inside the
+    ``return {...}`` of the module-level helper the branch calls — so a
+    metric dropped from a summary helper fails the lint even though both
+    branches still name the block."""
     if bench_py is None:
         bench_py = os.path.join(os.path.dirname(root), "bench.py")
     if not os.path.isfile(bench_py):
         return [f"bench contract: {bench_py} missing"]
     tree = ast.parse(open(bench_py, encoding="utf-8").read(), bench_py)
+    top, _, sub = key.partition(".")
+    funcs = {n.name: n for n in ast.walk(tree)
+             if isinstance(n, ast.FunctionDef)}
     hits = 0
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr == "dumps" and node.args
                 and isinstance(node.args[0], ast.Dict)):
-            keys = {k.value for k in node.args[0].keys
-                    if isinstance(k, ast.Constant)}
-            hits += key in keys
+            d = node.args[0]
+            if not sub:
+                hits += any(isinstance(k, ast.Constant) and k.value == top
+                            for k in d.keys)
+                continue
+            hits += any(isinstance(k, ast.Constant) and k.value == top
+                        and _value_carries_key(v, sub, funcs)
+                        for k, v in zip(d.keys, d.values))
     if hits < 2:
         return [f"bench contract: '{key}' key present in {hits} of the "
                 f"expected 2+ json.dumps branches of bench.py"]
@@ -181,6 +216,10 @@ def main(argv: list[str] | None = None) -> int:
                 + check_prom_metrics(root) + check_bench_contract(root)
                 + check_bench_contract(root, key="mirror")
                 + check_bench_contract(root, key="read")
+                + check_bench_contract(root, key="read.chunk_cache_hit_ratio")
+                + check_bench_contract(root, key="read.read_batches")
+                + check_bench_contract(
+                    root, key="read.containers_decoded_per_read")
                 + check_bench_contract(root, key="scrub"))
     for p in problems:
         print(p)

@@ -78,6 +78,12 @@ PHASE_CLASS = {
     # packet run to the client are network waits the host could hide.
     "index_lookup": HOST, "cache_probe": HOST, "container_decode": HOST,
     "ec_gather": TRANSPORT, "net_send": TRANSPORT,
+    # A reader parked on the read coalescer's shared decode future
+    # (server/read_plane.py): a hideable wait — the real decode burns the
+    # vCPU under the LEAD reader's mirrored container_decode span, which
+    # wins the interval's class, so this only attributes the queue/window
+    # slack that nothing else covers.
+    "decode_wait": TRANSPORT,
 }
 
 # Deterministic attribution order when several phases of the winning class
@@ -88,7 +94,7 @@ PHASE_ORDER = ("device_wait", "wal_commit", "container_io", "dedup_lookup",
                "reduce_compute", "checksum", "buffer_assemble",
                "pipeline_submit", "index_lookup", "cache_probe",
                "container_decode", "recv", "mirror_stream", "ack",
-               "ec_gather", "net_send")
+               "ec_gather", "decode_wait", "net_send")
 
 
 def phase_class(name: str) -> str:

@@ -154,6 +154,25 @@ class TestParityCitations:
         problems = check_parity.check_bench_contract(root, key="mirror")
         assert not problems, "\n".join(problems)
 
+    def test_bench_read_keys_ride_both_json_branches(self):
+        """Dotted bench-contract lint for the read-plane serving-engine
+        keys: chunk_cache_hit_ratio / read_batches /
+        containers_decoded_per_read must be literal keys of the "read"
+        block's summary helper (the ``return {...}`` of _read_summary),
+        reachable from BOTH json.dumps branches — a key dropped from the
+        helper would silently vanish from the stamp on every backend."""
+        import hdrf_tpu
+        from hdrf_tpu.tools import check_parity
+
+        root = os.path.dirname(os.path.abspath(hdrf_tpu.__file__))
+        for key in ("read.chunk_cache_hit_ratio", "read.read_batches",
+                    "read.containers_decoded_per_read"):
+            problems = check_parity.check_bench_contract(root, key=key)
+            assert not problems, "\n".join(problems)
+        # the lint actually bites: a key nobody returns must fail
+        assert check_parity.check_bench_contract(
+            root, key="read.no_such_key_ever")
+
     def test_bench_scrub_block_in_both_json_branches(self):
         """Same contract for the integrity-scrub summary block: the
         bytes_verified / corrupt_total / garbage_bytes numbers
