@@ -533,6 +533,67 @@ def _scrub_summary(tmp: str) -> dict:
     }
 
 
+def _qos_summary() -> dict:
+    """Overload-plane stamp for the JSON line: a small in-process exercise
+    of the admission/shed/hedge machinery (utils/qos.py, utils/retry.py)
+    under an injected clock so the numbers are deterministic.  A hog
+    tenant burns 8x its burst and must shed with a retry-after hint; a
+    light tenant must still admit; a FairQueue flooded by the hog must
+    interleave the light tenant's items (ratio 1.0 = perfect round-robin,
+    ~0 = FIFO starvation); one stalled primary + one fast hedge through
+    ``hedged_quorum`` must land the hedge win.  Keys match the qos/ec
+    prom families so the bench line cross-checks /prom."""
+    from hdrf_tpu.utils import metrics, qos, retry
+
+    now = [0.0]
+    ctrl = qos.AdmissionController(rate_mb_s=1.0, burst_mb=1.0,
+                                   clock=lambda: now[0])
+    ctrl.admit("hog", "write")
+    ctrl.charge("hog", "write", 8 << 20)        # 8x the burst: deficit
+    sheds = 0
+    for _ in range(4):
+        try:
+            ctrl.admit("hog", "write")
+        except qos.ShedError:
+            sheds += 1
+    ctrl.admit("light", "write")                # light tenant unaffected
+
+    class _It:  # FairQueue routes on .tenant
+        __slots__ = ("tenant",)
+
+        def __init__(self, tenant):
+            self.tenant = tenant
+
+    q = qos.FairQueue()
+    n_light = 8
+    for _ in range(64):
+        q.put(_It("hog"))
+    for _ in range(n_light):
+        q.put(_It("light"))
+    served_light = sum(1 for _ in range(2 * n_light)
+                       if q.get_nowait().tenant == "light")
+
+    ec_reg = metrics.registry("ec")
+
+    def _stalled():
+        time.sleep(0.2)
+        return "slow"
+
+    wins, _errs, _hedged = retry.hedged_quorum(
+        [_stalled], [lambda: "fast"], k=1, hedge_after_s=0.01,
+        on_hedge=lambda: ec_reg.incr("ec_hedges_fired"))
+    for leg_i, _payload in wins:
+        if leg_i >= 1:
+            ec_reg.incr("ec_hedge_wins")
+    return {
+        "sheds": sheds,
+        "shed_retry_after_p50_ms": round(ctrl.shed_retry_after_p50_ms(), 3),
+        "tenant_fairness_ratio": round(served_light / n_light, 4),
+        "ec_hedges_fired": ec_reg.counter("ec_hedges_fired"),
+        "ec_hedge_wins": ec_reg.counter("ec_hedge_wins"),
+    }
+
+
 def _multichip_summary() -> dict:
     """Mesh-plane service-rate stamp for the JSON line: the `benchmarks
     multichip` sub-harness (1/2/4/8-device curve, native-oracle pinned,
@@ -650,6 +711,7 @@ def main() -> None:
                 "mirror": _mirror_summary(),
                 "read": _read_summary(tmp),
                 "scrub": _scrub_summary(tmp),
+                "qos": _qos_summary(),
                 "phase_profile": phase_profile,
                 "pipeline": _pipeline_summary(phase_profile),
                 "multichip": _multichip_summary(),
@@ -980,6 +1042,7 @@ def main() -> None:
             "mirror": _mirror_summary(),
             "read": _read_summary(tmp),
             "scrub": _scrub_summary(tmp),
+            "qos": _qos_summary(),
             "phase_profile": phase_profile,
             "pipeline": _pipeline_summary(phase_profile),
             "multichip": _multichip_summary(),

@@ -29,7 +29,7 @@ from hdrf_tpu import native
 from hdrf_tpu.config import ClientConfig
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import RpcClient, recv_frame
-from hdrf_tpu.utils import metrics, retry, rollwin, tracing
+from hdrf_tpu.utils import metrics, qos, retry, rollwin, tracing
 
 _M = metrics.registry("client")
 _TR = tracing.tracer("client")
@@ -473,9 +473,24 @@ class HdrfClient:
                 dl.check("block write retry")
             alloc = self._call("add_block", path=path, client=self.name)
             bid = alloc["block_id"]
+            shed_hint = None
             try:
                 self._stream_block(alloc, block)
                 return bid
+            except qos.ShedError as e:
+                # structured admission refusal: retry, but wait the DN's
+                # own estimate instead of blind backoff
+                last_err = e
+                shed_hint = e.retry_after_s
+                _M.incr("write_sheds_seen")
+                self._call("abandon_block", path=path, client=self.name,
+                              block_id=bid)
+                # futile retry: the DN says admission needs longer than
+                # the whole remaining budget — surface the shed now
+                # instead of sleeping the deadline away
+                if shed_hint and dl is not None \
+                        and shed_hint > dl.remaining():
+                    raise last_err
             except (OSError, ConnectionError, IOError) as e:
                 last_err = e
                 _M.incr("block_write_retries")
@@ -483,10 +498,14 @@ class HdrfClient:
                               block_id=bid)
             if attempt < retries - 1:
                 delay = next(delays)
+                if shed_hint:
+                    delay = max(delay, shed_hint)
                 if dl is not None:
                     delay = min(delay, dl.remaining())
                 if delay > 0:
                     _t.sleep(delay)
+        if isinstance(last_err, qos.ShedError):
+            raise last_err  # keep the structured retryable type + hint
         raise IOError(f"block write failed after {retries} attempts: {last_err}")
 
     def _stream_block(self, alloc: dict, block: bytes) -> None:
@@ -504,9 +523,17 @@ class HdrfClient:
                        _client=self.name)
             npkts = dt.stream_bytes(sock, block, self.config.packet_size)
             # Drain per-packet acks; the final one carries pipeline status.
+            # A shed ack's seqno field carries the DN's retry-after hint in
+            # ms (datatransfer.py ACK_SHED — the block was refused at
+            # admission, nothing was stored).
             status = dt.ACK_SUCCESS
+            hint = 0
             for _ in range(npkts):
-                _, status = dt.read_ack(sock)
+                hint, status = dt.read_ack(sock)
+            if status == dt.ACK_SHED:
+                raise qos.ShedError(
+                    f"block {alloc['block_id']} shed at admission",
+                    retry_after_s=hint / 1e3)
             if status != dt.ACK_SUCCESS:
                 raise IOError(f"pipeline returned status {status}")
         finally:
@@ -750,6 +777,12 @@ class HdrfClient:
                        length=length, token=token, _client=self.name)
             hdr = recv_frame(sock)
             if hdr["status"] != 0:
+                if hdr.get("error") == "ShedError":
+                    # structured admission refusal: typed + retry-after so
+                    # callers can wait exactly as long as the DN estimated
+                    raise qos.ShedError(
+                        f"datanode shed: {hdr.get('message', '')}",
+                        retry_after_s=float(hdr.get("retry_after_s") or 0.0))
                 raise IOError(f"datanode error: {hdr['error']}: {hdr['message']}")
             data = dt.collect_packets(sock)
             if len(data) != hdr["length"]:

@@ -170,6 +170,27 @@ class ReductionConfig:
     # fetch stage (the read-side sibling of pipeline_max_inflight; the
     # DN-level max_concurrent_reads gate still applies outside it).
     read_max_inflight: int = 16
+    # Per-tenant QoS admission (utils/qos.py): token-bucket refill rate in
+    # MB/s and burst depth in MB, per tenant, shared across the DN's write
+    # and read planes.  0 rate disables bucket-based admission (the
+    # deadline shed below still applies); the bucket is a DEFICIT bucket —
+    # admission charges nothing, actual bytes are debited after the op.
+    qos_tenant_rate_mb_s: float = 0.0
+    qos_tenant_burst_mb: float = 8.0
+    # Deadline-aware load shedding: an op whose ambient ``_deadline``
+    # budget cannot cover (rolling-p95 service time) * this multiplier is
+    # refused AT ADMISSION with a retryable ShedError + retry-after hint,
+    # instead of burning a slot to time out mid-pipeline.  Only fires when
+    # the client sent a deadline AND the estimator has warmed up (≥5
+    # samples in the 5-minute window).  0 disables.
+    shed_p95_mult: float = 3.0
+    # k+δ hedged stripe reads (server/ec_tier.py _gather): number of extra
+    # stripe legs launched alongside the k primaries once the rolling
+    # per-holder p95 leg latency (* mirror_hedge_p95_mult, floored at
+    # mirror_hedge_floor_s) elapses — decode proceeds from the first k legs
+    # to land, so one straggling holder never sets read latency.
+    # 0 restores the serial holder-by-holder gather.
+    ec_read_hedge_delta: int = 1
     cdc: CdcConfig = field(default_factory=CdcConfig)
 
 

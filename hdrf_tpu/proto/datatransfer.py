@@ -46,6 +46,12 @@ FLAG_SYNC = 0x4
 ACK = struct.Struct("<QB")
 ACK_SUCCESS = 0
 ACK_ERROR = 1
+# Admission shed (utils/qos.py ShedError crossing the write wire): the DN
+# refused the block AT ADMISSION — retryable, nothing was stored.  Shed
+# acks repurpose the seqno field to carry the retry-after hint in
+# MILLISECONDS (the 8-byte slot is wasted on a refusal; the reference's
+# PipelineAck rides ECN/restart hints in spare header fields the same way).
+ACK_SHED = 2
 
 DEFAULT_PACKET = 64 * 1024
 
@@ -173,6 +179,12 @@ def fetch_block(addr: tuple, block_id: int, offset: int = 0,
                 length=length, token=token)
         hdr = recv_frame(sock)
         if hdr["status"] != 0:
+            if hdr.get("error") == "ShedError":
+                from hdrf_tpu.utils import qos
+
+                raise qos.ShedError(
+                    f"datanode shed: {hdr.get('message', '')}",
+                    retry_after_s=float(hdr.get("retry_after_s") or 0.0))
             raise IOError(f"datanode error: {hdr['error']}: "
                           f"{hdr.get('message', '')}")
         data = collect_packets(sock)

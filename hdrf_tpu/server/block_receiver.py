@@ -43,8 +43,8 @@ from typing import TYPE_CHECKING
 from hdrf_tpu import native
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import recv_frame, send_frame
-from hdrf_tpu.utils import (fault_injection, log, metrics, profiler, retry,
-                            tracing)
+from hdrf_tpu.utils import (fault_injection, log, metrics, profiler, qos,
+                            retry, tracing)
 
 if TYPE_CHECKING:
     from hdrf_tpu.server.datanode import DataNode
@@ -267,8 +267,28 @@ class BlockReceiver:
         scheme_name = fields["scheme"]
         targets = fields.get("targets", [])
         scheme = dn.scheme(scheme_name)
+        tenant = fields.get("_client")
+        t_start = time.monotonic()
+        # Overload gate BEFORE the slot and the buffer (utils/qos.py): a
+        # shed burns neither an admission slot nor pipeline work.  The
+        # write protocol has no pre-stream response frame, so the client
+        # streams regardless — consume the packet run (flow control only,
+        # nothing buffered) and answer every packet with an ACK_SHED whose
+        # seqno field carries the retry-after hint in ms
+        # (proto/datatransfer.py ACK_SHED).  Unattributed ingests (mirror
+        # relays re-entering as write ops) are internal and never shed.
+        if tenant is not None:
+            try:
+                dn.qos.admit(tenant, "write")
+            except qos.ShedError as e:
+                _M.incr("write_sheds")
+                hint_ms = int(max(e.retry_after_s, 0.0) * 1e3)
+                for _seqno, _data, _last in dt.iter_packets(sock):
+                    dt.send_ack(sock, hint_ms, dt.ACK_SHED)
+                raise
         with profiler.block_timeline(block_id) as tl, \
-                dn.write_slot():  # admission BEFORE buffering
+                dn.write_slot(), \
+                qos.bind_tenant(tenant):  # admission BEFORE buffering
             parts: list[bytes] = []
             last_seqno = [0]
             # each next() wait on the client stream is one "recv" span
@@ -364,6 +384,11 @@ class BlockReceiver:
                     precomputed=precomputed, crcs=crcs)
             with profiler.phase("ack"):
                 dt.send_ack(sock, last_seqno[0], status)
+            if tenant is not None:
+                # deficit bucket debit + write service estimator feed:
+                # actual bytes are only known after the stream landed
+                dn.qos.charge(tenant, "write", len(data),
+                              latency_s=time.monotonic() - t_start)
         _M.incr("blocks_received_reduced")
 
     def _drain_pipelined(self, sock: socket.socket, tl, block_id: int,

@@ -22,7 +22,7 @@ from typing import TYPE_CHECKING
 
 from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.proto.rpc import send_frame
-from hdrf_tpu.utils import metrics, profiler, tenants, tracing
+from hdrf_tpu.utils import metrics, profiler, qos, tenants, tracing
 
 if TYPE_CHECKING:
     from hdrf_tpu.server.datanode import DataNode
@@ -86,11 +86,21 @@ class BlockSender:
                 profiler.read_timeline(block_id) as tl:
             sp.annotate("block_id", block_id)
             try:
+                # Overload gate FIRST (utils/qos.py): over-rate tenants
+                # and ops whose deadline budget can't cover the p95
+                # estimate are refused here — before the read touches a
+                # slot, the cache, or the decode plane — with a structured
+                # retryable refusal instead of a mid-pipeline timeout.
+                # Unattributed requests (DN-to-DN reconstruction fan-in)
+                # are internal and never shed.
+                if tenant is not None:
+                    dn.qos.admit(tenant, "read")
                 # Umbrella phase: gaps between the inner spans (scheme
                 # resolution, read-slot admission, the materialize copy)
                 # attribute here; nested index_lookup/cache_probe spans
                 # still win their intervals (PHASE_ORDER lists them first).
-                with profiler.phase("container_decode"):
+                with qos.bind_tenant(tenant), \
+                        profiler.phase("container_decode"):
                     with profiler.phase("index_lookup"):
                         meta = dn.replicas.get_meta(block_id)
                         region = (dn.aliasmap.read(block_id) if meta is None
@@ -102,9 +112,16 @@ class BlockSender:
                                              meta=meta)
                     tl.nbytes = len(data)
             except Exception as e:  # noqa: BLE001 — status crosses the wire
-                send_frame(sock, {"status": 1, "error": type(e).__name__,
-                                  "message": str(e)})
-                _M.incr("read_errors")
+                frame = {"status": 1, "error": type(e).__name__,
+                         "message": str(e)}
+                retry_after = getattr(e, "retry_after_s", None)
+                if retry_after is not None:
+                    frame["retry_after_s"] = retry_after
+                send_frame(sock, frame)
+                if isinstance(e, qos.ShedError):
+                    _M.incr("read_sheds")
+                else:
+                    _M.incr("read_errors")
                 tenants.note_op(tenant, "read",
                                 latency_s=time.monotonic() - t_start)
                 return
@@ -120,5 +137,8 @@ class BlockSender:
                 dt.stream_bytes(sock, data, dn.config.packet_size)
                 _M.incr("blocks_served")
                 _M.incr("bytes_served", len(data))
-        tenants.note_op(tenant, "read", len(data),
-                        latency_s=time.monotonic() - t_start)
+        served_s = time.monotonic() - t_start
+        tenants.note_op(tenant, "read", len(data), latency_s=served_s)
+        # deficit bucket debit + service estimator feed (utils/qos.py):
+        # bytes are only known NOW, so admission charged nothing
+        dn.qos.charge(tenant, "read", len(data), latency_s=served_s)

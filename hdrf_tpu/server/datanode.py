@@ -44,8 +44,8 @@ from hdrf_tpu.server.block_sender import BlockSender
 from hdrf_tpu.server.status_http import StatusHttpServer
 from hdrf_tpu.reduction import accounting
 from hdrf_tpu.utils import (device_ledger, fault_injection, flight_recorder,
-                            log, metrics, profiler, retry, rollwin, tenants,
-                            tracing)
+                            log, metrics, profiler, qos, retry, rollwin,
+                            tenants, tracing)
 from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("datanode")
@@ -239,6 +239,14 @@ class DataNode:
         self.reduction_ctx = ReductionContext(
             config=red, containers=self.containers, index=self.index,
             backend=backend, worker=self._worker, recon=recon)
+        # Overload-safety plane (utils/qos.py): one AdmissionController
+        # shared by the read and write planes — per-tenant token buckets
+        # plus deadline-aware shedding, surfaced on /prom, /health, the
+        # flight recorder, and the heartbeat stats.
+        self.qos = qos.AdmissionController(
+            rate_mb_s=red.qos_tenant_rate_mb_s,
+            burst_mb=red.qos_tenant_burst_mb,
+            shed_p95_mult=red.shed_p95_mult)
         # Chunk-granular serving engine (server/read_plane.py): shared
         # decoded-chunk cache + coalesced container decodes.  The retire
         # hook drops cached chunks when a container is quarantined or
@@ -248,7 +256,8 @@ class DataNode:
         self.read_plane = ReadPlane(
             self.containers, chunk_cache_mb=red.chunk_cache_mb,
             window_ms=red.read_batch_window_ms,
-            max_inflight=red.read_max_inflight, backend=backend)
+            max_inflight=red.read_max_inflight, backend=backend,
+            qos_ctrl=self.qos)
         self.read_plane.attach_store(self.containers)
         self.reduction_ctx.read_plane = self.read_plane
         # EC cold tier (server/ec_tier.py): stripe store + demote/serve/
@@ -274,7 +283,8 @@ class DataNode:
                 max_inflight=red.pipeline_max_inflight,
                 mesh_plane=red.mesh_plane,
                 mesh_lanes=red.mesh_lanes_per_device,
-                mesh_bucket_slots=red.mesh_bucket_slots)
+                mesh_bucket_slots=red.mesh_bucket_slots,
+                qos_ctrl=self.qos)
             if self.write_pipeline.mesh_reducer is not None:
                 # the device bucket table tracks the authoritative index
                 # incrementally: every commit's first-seen fingerprints
@@ -644,6 +654,11 @@ class DataNode:
                 self._dispatch_op(sock, op, fields)
         except PermissionError:
             _M.incr("op_auth_failures")
+        except qos.ShedError:
+            # admission refusals are intentional overload behavior, not
+            # op failures — ShedError subclasses IOError, so this clause
+            # must sit ABOVE the OSError arm to keep the books honest
+            _M.incr("op_sheds")
         except (ConnectionError, OSError):
             _M.incr("op_io_errors")
         except Exception:  # noqa: BLE001 — xceiver thread must not die silently
@@ -990,6 +1005,7 @@ class DataNode:
             "chunk_cache_bytes": self.read_plane.cache.bytes_used,
             "read_amplification": accounting.read_amplification_report(),
             "tenants": tenants.summaries(),
+            "qos": self.qos.report(),
         }
 
     @staticmethod
@@ -1034,6 +1050,11 @@ class DataNode:
             "breakers_half_open": sum(1 for s in states
                                       if s == "half_open"),
             "tenant_count": tenants.tenant_count(),
+            # overload plane (ISSUE 14): shed growth is the regression
+            # curve — a healthy cluster sheds ~0; the retry-after p50
+            # shows whether hints track the actual recovery horizon
+            "sheds_total": self.qos.sheds_total(),
+            "shed_retry_after_p50_ms": self.qos.shed_retry_after_p50_ms(),
             # integrity-drift curve (ISSUE 12 satellite: garbage growth
             # and corruption rate belong in the /timeseries regressions)
             "garbage_bytes": sum(self.scrubber._last_census.values()),
@@ -1062,6 +1083,7 @@ class DataNode:
             "ec": self.ec.report(),
             "mirror": self.mirror.report(),
             "scrub": self.scrubber.report(),
+            "qos": self.qos.report(),
         }
 
     def _execute(self, cmd: dict) -> None:

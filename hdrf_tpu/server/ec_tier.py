@@ -20,8 +20,15 @@ roles live here:
   chunk gather misses the sealed file, gather any k surviving stripes —
   local disk first, then peers, skipping breaker-open edges (PR-5
   evidence) — and reassemble the exact sealed bytes, decoding through
-  ops/rs.py only when a data stripe is lost.  The reconstructed payload
-  feeds the unchanged decompress + device chunk-gather path
+  ops/rs.py only when a data stripe is lost.  With
+  ``ec_read_hedge_delta`` > 0 the gather launches k primary legs PLUS
+  δ hedged legs through utils/retry.py:1 ``hedged_quorum`` once the
+  rolling per-holder p95 leg latency elapses, decoding from the first k
+  to land — the k+δ speculative-fetch result of the straggler-coding
+  line (arXiv 1802.03049; StripedBlockReader.java:40's serial legs are
+  the tail it removes), with the old serial loop kept as the fallback
+  when fewer than k legs can launch.  The reconstructed payload feeds
+  the unchanged decompress + device chunk-gather path
   (ops/reconstruct.py), so reads stay bit-identical to the replicated
   tier.
 - **Repair** (NN ``stripe_repair`` command): re-decode exactly the lost
@@ -32,11 +39,12 @@ roles live here:
 from __future__ import annotations
 
 import os
+import time
 
 from hdrf_tpu.reduction import accounting
 from hdrf_tpu.storage import stripe_store
 from hdrf_tpu.storage.container_store import _SEAL_HDR, _SEAL_MAGIC
-from hdrf_tpu.utils import fault_injection, metrics, profiler, retry
+from hdrf_tpu.utils import fault_injection, metrics, profiler, retry, rollwin
 
 _M = metrics.registry("ec")
 
@@ -69,6 +77,10 @@ class EcTier:
                 dn.index.drop_stripe(cid)
 
         dn.containers._on_delete = _on_delete
+        # rolling per-holder stripe-leg latency (seconds), the hedge
+        # trigger's p95 input (the gather-side sibling of the DN's
+        # _peer_win slow-peer windows)
+        self._leg_win = rollwin.WindowMap(window_s=300.0, maxlen=64)
 
     # ------------------------------------------------------------ hooks
 
@@ -279,8 +291,108 @@ class EcTier:
 
     def _gather(self, cid: int, manifest: dict,
                 exclude: set[int] | None = None) -> dict[int, bytes]:
-        """Fetch up to k stripes, data indices first (no decode needed when
-        all k arrive), skipping ``exclude`` and breaker-open peers."""
+        """k+δ straggler-proof stripe gather (utils/retry.py:194
+        ``hedged_quorum``; arXiv 1802.03049's speculative k+δ fetch):
+        launch k primary legs — data indices first, so no decode is
+        needed when all k land — plus up to ``ec_read_hedge_delta``
+        hedged legs once the rolling per-holder p95 leg latency elapses,
+        and decode from the FIRST k to land instead of waiting out a
+        stalled holder.  Falls back to the serial loop when δ = 0, when
+        fewer than k breaker-closed legs can launch, or when the hedged
+        fan-out itself misses quorum (mid-gather holder deaths beyond
+        what δ covered)."""
+        dn = self._dn
+        red = dn.reduction_ctx.config
+        k, m = int(manifest["k"]), int(manifest["m"])
+        owner = manifest.get("owner", dn.dn_id)
+        holders = manifest["holders"]
+        delta = int(getattr(red, "ec_read_hedge_delta", 0))
+        if delta <= 0:
+            return self._gather_serial(cid, manifest, exclude)
+
+        # Candidate legs in data-first order, minus excluded stripes and
+        # breaker-OPEN edges.  The .state peek is probe-free: half-open
+        # edges stay IN the candidate set and spend their single probe
+        # inside the leg via br.allow() at call time.
+        usable: list[int] = []
+        for idx in range(k + m):
+            if exclude and idx in exclude:
+                continue
+            tgt_id = holders[idx][0]
+            if (tgt_id != dn.dn_id
+                    and retry.breaker(f"{dn.dn_id}->{tgt_id}").state
+                    == "open"):
+                _M.incr("breaker_skips")
+                continue
+            usable.append(idx)
+        if len(usable) < k:
+            # Not enough live legs for a quorum launch; the serial loop
+            # still gathers whatever exists (caller handles < k).
+            return self._gather_serial(cid, manifest, exclude)
+        primaries = usable[:k]
+        hedge_idxs = usable[k:k + delta]
+
+        def leg(idx: int):
+            tgt_id, host, port = (holders[idx][0], holders[idx][1],
+                                  int(holders[idx][2]))
+
+            def run():
+                fault_injection.point("ec.stripe_hedge", dn_id=dn.dn_id,
+                                      holder=tgt_id, idx=idx)
+                t0 = time.monotonic()
+                if tgt_id == dn.dn_id:
+                    data = self.store.read_stripe(owner, cid, idx)
+                else:
+                    br = retry.breaker(f"{dn.dn_id}->{tgt_id}")
+                    if not br.allow():
+                        raise retry.BreakerOpen(f"{dn.dn_id}->{tgt_id}")
+                    try:
+                        resp = dn._peer_call((host, port), "stripe_read",
+                                             owner=owner, cid=cid, idx=idx)
+                        if not resp.get("ok"):
+                            raise IOError(
+                                resp.get("error", "stripe_read failed"))
+                        data = resp["data"]
+                    except (OSError, ConnectionError, IOError, KeyError):
+                        br.record_failure()
+                        raise
+                    br.record_success()
+                self._leg_win.note(tgt_id, time.monotonic() - t0)
+                return idx, data
+
+            return run
+
+        sums = self._leg_win.summaries()
+        p95s = [sums[holders[i][0]]["p95"] for i in primaries
+                if holders[i][0] in sums]
+        hedge_after = max((max(p95s) if p95s else 0.0)
+                          * red.mirror_hedge_p95_mult,
+                          red.mirror_hedge_floor_s)
+        try:
+            with profiler.phase("ec_gather"):
+                wins, _errors, _hedged = retry.hedged_quorum(
+                    [leg(i) for i in primaries],
+                    [leg(i) for i in hedge_idxs],
+                    k=k, hedge_after_s=hedge_after,
+                    timeout_s=_CMD_BUDGET_S,
+                    on_hedge=lambda: _M.incr("ec_hedges_fired"))
+        except retry.QuorumFailed:
+            _M.incr("ec_hedge_fallbacks")
+            return self._gather_serial(cid, manifest, exclude)
+        got: dict[int, bytes] = {}
+        for leg_i, (sidx, data) in wins:
+            got[sidx] = data
+            if leg_i >= len(primaries):
+                _M.incr("ec_hedge_wins")
+        accounting.record_stripe_gather(sum(len(v) for v in got.values()))
+        return got
+
+    def _gather_serial(self, cid: int, manifest: dict,
+                       exclude: set[int] | None = None) -> dict[int, bytes]:
+        """Serial fallback gather: fetch up to k stripes one holder at a
+        time, data indices first, skipping ``exclude`` and breaker-open
+        peers (the pre-hedging PR-10 path, kept for δ = 0 and for
+        quorum-miss recovery)."""
         dn = self._dn
         k, m = int(manifest["k"]), int(manifest["m"])
         owner = manifest.get("owner", dn.dn_id)

@@ -503,6 +503,10 @@ class HttpGateway:
                 # behind the degraded expression above
                 "fsck_violations": fsck_violations,
                 "scrub_corrupt_total": scrub_corrupt,
+                # overload plane: admission sheds are intentional refusals
+                # (kept out of the degraded verdict — a shedding cluster is
+                # protecting itself, not failing)
+                "qos_sheds_total": cluster.get("qos_sheds_total", 0),
                 "garbage_bytes": cluster.get("garbage_bytes", 0),
                 "scrub_repairs_triggered":
                     cluster.get("scrub_repairs_triggered", 0)}

@@ -2791,6 +2791,7 @@ class NameNode:
             ded_logical = ded_unique = 0
             ec_striped = ec_logical = ec_physical = 0
             scrub_corrupt = scrub_garbage = scrub_repairs = 0
+            qos_sheds = 0
             for d in self._datanodes.values():
                 alive = (now - d.last_heartbeat
                          < self.config.dead_node_interval_s)
@@ -2815,6 +2816,8 @@ class NameNode:
                 scrub_corrupt += int(sc.get("corrupt_total", 0))
                 scrub_garbage += int(sc.get("garbage_bytes", 0))
                 scrub_repairs += int(sc.get("repairs_triggered", 0))
+                qo = st.get("qos") or {}
+                qos_sheds += int(qo.get("sheds_total", 0))
             # The under-replicated count is the redundancy monitor's own
             # (cached each _check_replication tick) — recomputing it here
             # would both duplicate the want/counted semantics and walk
@@ -2859,6 +2862,11 @@ class NameNode:
                 "scrub_corrupt_total": scrub_corrupt,
                 "garbage_bytes": scrub_garbage,
                 "scrub_repairs_triggered": scrub_repairs,
+                # overload plane (ISSUE 14): cluster-wide admission sheds
+                # from DN heartbeats — intentional refusals under overload,
+                # NOT a degraded-verdict input (shedding is the system
+                # working; breakers/deadline failures flag separately)
+                "qos_sheds_total": qos_sheds,
                 "fsck_violations": (self._last_fsck or {}).get(
                     "violations", 0),
                 "editlog_seq": self._editlog.seq,

@@ -45,7 +45,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 
 from hdrf_tpu.ops import dispatch
-from hdrf_tpu.utils import metrics, profiler
+from hdrf_tpu.utils import metrics, profiler, qos
 
 _M = metrics.registry("read_plane")
 
@@ -224,12 +224,14 @@ class ChunkCache:
 
 
 class _Req:
-    __slots__ = ("cids", "future", "timeline")
+    __slots__ = ("cids", "future", "timeline", "tenant")
 
-    def __init__(self, cids: list, future: Future, timeline) -> None:
+    def __init__(self, cids: list, future: Future, timeline,
+                 tenant: str | None = None) -> None:
         self.cids = cids
         self.future = future
         self.timeline = timeline
+        self.tenant = tenant
 
 
 class ReadCoalescer:
@@ -244,13 +246,18 @@ class ReadCoalescer:
 
     def __init__(self, containers, window_ms: float = 2.0,
                  max_inflight: int = 16, depth: int = 8,
-                 backend: str = "native", batched: bool | None = None):
+                 backend: str = "native", batched: bool | None = None,
+                 qos_ctrl=None):
         self._containers = containers
         self._window_s = max(window_ms, 0.0) / 1000.0
         self._depth = max(depth, 1)
         self._backend = backend
+        self._qos = qos_ctrl
         self._sem = threading.BoundedSemaphore(max(max_inflight, 1))
-        self._q: queue.Queue = queue.Queue()
+        # weighted-fair dequeue across tenants (utils/qos.py FairQueue) —
+        # a flooding tenant's queued decode groups cannot starve a light
+        # tenant's (the coalescer window still batches across lanes)
+        self._q = qos.FairQueue()
         self._thread: threading.Thread | None = None
         if batched is None:
             batched = backend == "tpu" and window_ms > 0 and max_inflight > 1
@@ -263,10 +270,19 @@ class ReadCoalescer:
         return dispatch.block_decompress_batch(codec_names, blobs, usizes,
                                                self._backend)
 
-    def fetch(self, cids: list, timeline=None) -> dict:
+    def fetch(self, cids: list, timeline=None,
+              tenant: str | None = None) -> dict:
         """Decoded payloads for ``cids`` (cid -> bytes).  Blocks at the
         admission bound; in batched mode the call parks on the group's
-        future while the worker decodes under the lead member's timeline."""
+        future while the worker decodes under the lead member's timeline.
+        Sheds (qos.ShedError) BEFORE acquiring a permit when the ambient
+        tenant is over rate or the deadline cannot cover the estimate."""
+        if tenant is None:
+            tenant = qos.current_tenant()
+        # unattributed callers (scrub, EC reconstruction, compaction) are
+        # internal housekeeping — never shed them, only client traffic
+        if self._qos is not None and tenant is not None:
+            self._qos.admit(tenant, "read")
         if not self._sem.acquire(timeout=300):
             raise TimeoutError("read plane admission timeout")
         try:
@@ -277,7 +293,8 @@ class ReadCoalescer:
                         cids, decompress_batch=self._decomp)
             fut: Future = Future()
             self._q.put(_Req(list(cids), fut,
-                             timeline or profiler.current_timeline()))
+                             timeline or profiler.current_timeline(),
+                             tenant))
             with profiler.phase("decode_wait"):
                 return fut.result(timeout=300)
         finally:
@@ -356,11 +373,13 @@ class ReadPlane:
 
     def __init__(self, containers, chunk_cache_mb: float = 8,
                  window_ms: float = 2.0, max_inflight: int = 16,
-                 backend: str = "native", batched: bool | None = None):
+                 backend: str = "native", batched: bool | None = None,
+                 qos_ctrl=None):
         self.cache = ChunkCache(int(chunk_cache_mb * (1 << 20)))
         self.coalescer = ReadCoalescer(containers, window_ms=window_ms,
                                        max_inflight=max_inflight,
-                                       backend=backend, batched=batched)
+                                       backend=backend, batched=batched,
+                                       qos_ctrl=qos_ctrl)
         self._containers = containers
 
     def attach_store(self, containers) -> None:

@@ -8,11 +8,15 @@ is the admission/coalescing stage the vectorized-chunking line needs to
 keep the device fed (SURVEY.md §2.1; PERF_NOTES.md round 10 measured the
 serial path at 0.0% overlap efficiency):
 
-- ``submit(block_id, data, timeline)`` hands a fully-buffered block to the
-  pipeline and returns a Future of ``(cuts, digests)``.  Admission is
-  bounded by ``pipeline_max_inflight`` (config.py ReductionConfig) — the
-  same bounded-slots discipline the DN's write_slot applies to buffering
-  (DataXceiver.java:349-380's gate, applied one stage later).
+- ``submit(block_id, data, timeline, tenant)`` hands a fully-buffered
+  block to the pipeline and returns a Future of ``(cuts, digests)``.
+  Admission is bounded by ``pipeline_max_inflight`` (config.py
+  ReductionConfig) — the same bounded-slots discipline the DN's write_slot
+  applies to buffering (DataXceiver.java:349-380's gate, applied one stage
+  later) — and, when an AdmissionController is installed, gated per tenant
+  by utils/qos.py:1 (token-bucket + deadline shed BEFORE a permit is
+  held); the coalescer queue is a weighted-fair qos.FairQueue so queued
+  blocks drain round-robin across tenants.
 - On the TPU backend a single coalescer thread drains queued blocks up to
   ``pipeline_depth`` per round, groups equal lengths, and runs each group
   through ONE ResidentReducer program (ops/resident.py:358 submit_many —
@@ -52,20 +56,21 @@ from concurrent.futures import Future
 import numpy as np
 
 from hdrf_tpu.ops import dispatch
-from hdrf_tpu.utils import metrics, profiler
+from hdrf_tpu.utils import metrics, profiler, qos
 
 _M = metrics.registry("write_pipeline")
 
 
 class _Item:
-    __slots__ = ("block_id", "arr", "timeline", "future")
+    __slots__ = ("block_id", "arr", "timeline", "future", "tenant")
 
     def __init__(self, block_id: int, arr: np.ndarray, timeline,
-                 future: Future) -> None:
+                 future: Future, tenant: str | None = None) -> None:
         self.block_id = block_id
         self.arr = arr
         self.timeline = timeline
         self.future = future
+        self.tenant = tenant
 
 
 class WritePipeline:
@@ -73,10 +78,15 @@ class WritePipeline:
 
     def __init__(self, cdc, backend: str, depth: int = 4,
                  max_inflight: int = 8, mesh_plane: bool = False,
-                 mesh_lanes: int = 2, mesh_bucket_slots: int = 1 << 15):
+                 mesh_lanes: int = 2, mesh_bucket_slots: int = 1 << 15,
+                 qos_ctrl=None):
         self._cdc = cdc
         self._backend = backend
         self._depth = max(depth, 1)
+        # DN-wide admission gate (utils/qos.py AdmissionController): when
+        # installed, submit() sheds over-rate / deadline-doomed tenants
+        # BEFORE a pipeline permit is held.
+        self._qos = qos_ctrl
         # Mesh-sharded reduction plane (ReductionConfig.mesh_plane): one
         # dispatch per mesh step for the whole coalesced group, dedup probe
         # answered on-mesh.  Futures then resolve (cuts, digests, probe)
@@ -91,7 +101,10 @@ class WritePipeline:
                 # coalescer must be allowed to drain at least that many
                 self._depth = max(self._depth, self.mesh_reducer.max_group())
         self._sem = threading.BoundedSemaphore(max(max_inflight, 1))
-        self._q: queue.Queue = queue.Queue()
+        # Weighted-fair dequeue (qos.FairQueue, queue.Queue-compatible):
+        # per-tenant lanes drain round-robin so a flooding tenant's queued
+        # blocks cannot starve a light tenant's (FairCallQueue.java:214).
+        self._q = qos.FairQueue()
         self._thread: threading.Thread | None = None
         if backend == "tpu" and self._depth > 1:
             self._thread = threading.Thread(target=self._coalesce_loop,
@@ -101,16 +114,34 @@ class WritePipeline:
 
     # ------------------------------------------------------------ admission
 
-    def submit(self, block_id: int, data, timeline=None) -> Future:
+    def submit(self, block_id: int, data, timeline=None,
+               tenant: str | None = None) -> Future:
         """Reduce ``data`` (host bytes / u8 array); Future resolves to
         ``(cuts, digests)``.  Blocks at the ``pipeline_max_inflight``
-        admission bound (backpressure on client streams)."""
+        admission bound (backpressure on client streams); sheds (raises
+        qos.ShedError) before acquiring a permit when the tenant is over
+        rate or the ambient deadline cannot cover the service estimate."""
         arr = (data if isinstance(data, np.ndarray)
                else np.frombuffer(data, dtype=np.uint8))
+        if tenant is None:
+            tenant = qos.current_tenant()
+        # unattributed submits (mirror ingest, re-reduction) are internal
+        # relays already admitted at the head DN — never shed them
+        if self._qos is not None and tenant is not None:
+            self._qos.admit(tenant, "write")
         if not self._sem.acquire(timeout=300):
             raise TimeoutError("write pipeline admission timeout")
-        fut: Future = Future()
-        fut.add_done_callback(lambda _f: self._sem.release())
+        # Permit-leak audit: between acquire and a successfully armed
+        # done-callback there is no release path — any raise in that
+        # window (Future alloc, callback attach) must hand the permit
+        # back inline.  Once the callback is armed, failing the future
+        # releases through it.
+        try:
+            fut: Future = Future()
+            fut.add_done_callback(lambda _f: self._sem.release())
+        except BaseException:
+            self._sem.release()
+            raise
         if self._thread is None:
             # Serial/native path: compute on the caller's thread — the
             # native choke point records its own reduce_compute phase.
@@ -121,7 +152,11 @@ class WritePipeline:
             except BaseException as e:  # noqa: BLE001 — caller unwraps
                 fut.set_exception(e)
             return fut
-        self._q.put(_Item(block_id, arr, timeline, fut))
+        try:
+            self._q.put(_Item(block_id, arr, timeline, fut, tenant))
+        except BaseException as e:  # noqa: BLE001 — permit rides the future
+            fut.set_exception(e)
+            raise
         return fut
 
     def close(self) -> None:

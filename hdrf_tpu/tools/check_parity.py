@@ -220,7 +220,11 @@ def main(argv: list[str] | None = None) -> int:
                 + check_bench_contract(root, key="read.read_batches")
                 + check_bench_contract(
                     root, key="read.containers_decoded_per_read")
-                + check_bench_contract(root, key="scrub"))
+                + check_bench_contract(root, key="scrub")
+                + check_bench_contract(root, key="qos")
+                + check_bench_contract(root, key="qos.sheds")
+                + check_bench_contract(root, key="qos.tenant_fairness_ratio")
+                + check_bench_contract(root, key="qos.ec_hedge_wins"))
     for p in problems:
         print(p)
     print(f"{len(problems)} violation(s)" if problems

@@ -46,7 +46,10 @@ REGRESS_UP = ("read_p95_ms", "write_p95_ms", "stalls", "breakers_open",
               "read_amplification",
               # integrity drift (ISSUE 12): garbage growth and scrub/fsck
               # corruption counts only ever regress upward
-              "garbage_bytes", "scrub_corrupt_total", "fsck_violations")
+              "garbage_bytes", "scrub_corrupt_total", "fsck_violations",
+              # overload plane (ISSUE 14): a shed-rate climb is the QoS
+              # plane absorbing pressure — flag it before clients notice
+              "sheds_total")
 REGRESS_DOWN = ("container_cache_hit_ratio", "cache_hit_ratio",
                 "dedup_ratio", "datanodes_live")
 # Relative drift below this never flags (jitter floor), and a baseline of

@@ -186,6 +186,22 @@ class TestParityCitations:
         problems = check_parity.check_bench_contract(root, key="scrub")
         assert not problems, "\n".join(problems)
 
+    def test_bench_qos_block_in_both_json_branches(self):
+        """Overload-plane bench contract (ISSUE 14): the "qos" block —
+        sheds / shed_retry_after_p50_ms / tenant_fairness_ratio /
+        ec_hedges_fired / ec_hedge_wins from _qos_summary — must be a
+        literal key in BOTH json.dumps branches of bench.py, and the
+        summary keys must be literal keys of the helper's return dict."""
+        import hdrf_tpu
+        from hdrf_tpu.tools import check_parity
+
+        root = os.path.dirname(os.path.abspath(hdrf_tpu.__file__))
+        for key in ("qos", "qos.sheds", "qos.shed_retry_after_p50_ms",
+                    "qos.tenant_fairness_ratio", "qos.ec_hedges_fired",
+                    "qos.ec_hedge_wins"):
+            problems = check_parity.check_bench_contract(root, key=key)
+            assert not problems, "\n".join(problems)
+
 
 class TestOfflineViewers:
     def test_oiv_oev(self, cluster, tmp_path):
