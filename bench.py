@@ -268,6 +268,27 @@ def _cdc_fused_summary() -> dict:
     }
 
 
+def _cdc_adaptive_summary() -> dict:
+    """Adaptive-chunking sub-dict for the JSON line (ISSUE 15): which scan
+    variant the run used, the skip-ahead kernel's slab-survivor/candidate
+    telemetry, the effective geometry the accounting plane last stamped,
+    and how many live retunes the DataNode controller drove.  All zeros
+    under ``HDRF_CDC_SKIP_AHEAD=0`` or with ``cdc_adaptive`` off — the
+    keys stay present so tools/check_parity.py's bench contract holds on
+    every path."""
+    from hdrf_tpu.ops.cdc_pallas import cdc_skip_ahead
+    from hdrf_tpu.reduction import accounting
+
+    snap = accounting.snapshot()
+    ctr, gauges = snap["counters"], snap["gauges"]
+    return {
+        "skip_ahead": cdc_skip_ahead(),
+        "scan_slab_survivors": int(ctr.get("cdc_scan_slab_survivors", 0)),
+        "mask_bits_effective": int(gauges.get("cdc_mask_bits_effective", 0)),
+        "retunes": int(ctr.get("cdc_retunes", 0)),
+    }
+
+
 def _slow_peer_count() -> int:
     """Slow peers flagged by the cluster outlier detector — the bench runs
     no cluster, so this is the detector's verdict over an empty report set
@@ -705,6 +726,7 @@ def main() -> None:
                 "slow_peer_count": _slow_peer_count(),
                 "ledger": led,
                 "cdc_fused": _cdc_fused_summary(),
+                "cdc_adaptive": _cdc_adaptive_summary(),
                 "stalls": led.get("stall_total", 0),
                 "resilience": _resilience_summary(),
                 "ec": _ec_summary(),
@@ -1036,6 +1058,7 @@ def main() -> None:
             "slow_peer_count": _slow_peer_count(),
             "ledger": led,
             "cdc_fused": _cdc_fused_summary(),
+            "cdc_adaptive": _cdc_adaptive_summary(),
             "stalls": led.get("stall_total", 0),
             "resilience": _resilience_summary(),
             "ec": _ec_summary(),

@@ -501,24 +501,29 @@ def bench_sort(args) -> None:
 
 
 def bench_cdc(args) -> None:
-    """Fused Pallas CDC front end A/B: ops/cdc_pallas.py (device-side cut
-    selection, in-kernel BE image) vs the XLA ``_prep`` pipeline stage
-    (ops/resident.py: MXU BE word image + gear scan + packed candidate
-    bitmap, host-selected cuts), slope method — k salted iterations in ONE
-    dispatch with a dependent readback divides out the ~100 ms transport
-    constant (PERF_NOTES.md round 4).  Prints exactly ONE JSON line, with
-    the per-block readback byte ledger (the XLA path's packed-candidate
-    D2H vs the fused path's cut table) and the serial awaited-boundary
-    count each shape pays per group.  Without a chip the kernel runs in
-    the Pallas interpreter — a correctness-grade timing, flagged in the
-    line (the round-6 precedent)."""
+    """Fused Pallas CDC front end, geometry-sweepable A/B (ISSUE 15): the
+    skip-ahead + sequence-select kernel vs the PR 4 fused scan vs the XLA
+    ``_prep`` pipeline stage (ops/resident.py), slope method — k salted
+    iterations in ONE dispatch with a dependent readback divides out the
+    ~100 ms transport constant (PERF_NOTES.md round 4).  ``--mask-bits`` /
+    ``--min-size`` sweep the geometry; ``--no-skip-ahead`` pins the PR 4
+    scan alone.  Prints exactly ONE JSON line carrying the paired A/B, the
+    per-leg micro-profile (gear = scan-only kernel slope, scan = fused
+    minus gear, image = be_word_image slope, pad = sha_pad_messages
+    slope — the round-17 PERF_NOTES table from one command), the kernel's
+    H_SURV/H_CANDS telemetry, and the per-block readback byte ledger.
+    Cuts are pinned bit-identical to native.cdc_chunk for every variant
+    BEFORE any timing.  Without a chip the kernels run in the Pallas
+    interpreter — a correctness-grade timing, flagged in the line (the
+    round-6 precedent)."""
     import jax
     import jax.numpy as jnp
 
+    from hdrf_tpu import native
     from hdrf_tpu.config import CdcConfig
     from hdrf_tpu.ops import cdc_pallas, resident
 
-    cdc = CdcConfig()
+    cdc = CdcConfig(mask_bits=args.mask_bits, min_chunk=args.min_size)
     r = resident.ResidentReducer(cdc, fused_mode="off")
     n = args.mb << 20
     rng = np.random.default_rng(17)
@@ -527,9 +532,16 @@ def bench_cdc(args) -> None:
 
     mode = cdc_pallas.cdc_pallas_mode()
     interpret = args.interpret or mode != "mosaic"
-    plan = cdc_pallas.plan_for(n, r.mask, cdc.mask_bits, cdc.min_chunk,
-                               cdc.max_chunk, r._b_small, r._b_big)
-    buf = np.zeros(plan.n_pad, dtype=np.uint8)
+    plans = {}
+    if args.skip_ahead:
+        plans["skip"] = cdc_pallas.plan_for(
+            n, r.mask, cdc.mask_bits, cdc.min_chunk, cdc.max_chunk,
+            r._b_small, r._b_big, skip_ahead=True)
+    plans["walk"] = cdc_pallas.plan_for(
+        n, r.mask, cdc.mask_bits, cdc.min_chunk, cdc.max_chunk,
+        r._b_small, r._b_big, skip_ahead=False)
+    n_pad = max(p.n_pad for p in plans.values())
+    buf = np.zeros(n_pad, dtype=np.uint8)
     buf[:n] = a
     w2d = jax.device_put(buf.view(np.uint32).reshape(-1, 128))
     pad512 = n + (-n) % 512
@@ -538,24 +550,91 @@ def bench_cdc(args) -> None:
     cap_x = max(1, min(pad512 // 32,
                        max(1024, (n >> max(cdc.mask_bits - 1, 0)) + 1024)))
 
-    def measure(build):
+    # -- correctness pin BEFORE timing: every variant's cuts must equal
+    # the native oracle (overflow => the variant reports it and equality
+    # is vacuous: callers take the oracle path).
+    want = native.cdc_chunk(a.tobytes(), r.mask, cdc.min_chunk,
+                            cdc.max_chunk)
+    surv = cands = 0
+    overflowed = False
+    for name, p in plans.items():
+        _, table, _, _ = jax.jit(
+            lambda w, p=p: cdc_pallas.fused_block(w, p, interpret))(w2d)
+        tb = np.asarray(table)[0]
+        if int(tb[cdc_pallas.H_OVERFLOW]):
+            overflowed = True
+            continue
+        nc = int(tb[cdc_pallas.H_COUNT])
+        got = tb[cdc_pallas.TABLE_HDR:cdc_pallas.TABLE_HDR + nc].astype(
+            np.uint64)
+        assert np.array_equal(got, np.asarray(want, np.uint64)), \
+            f"{name} kernel cuts diverge from native.cdc_chunk"
+        if name == "skip":
+            surv = int(tb[cdc_pallas.H_SURV])
+            cands = int(tb[cdc_pallas.H_CANDS])
+
+    def measure(build, inp):
         def timed(k):
             f = jax.jit(build(k))
-            int(f(w2d if build is build_fused else blk))  # compile + warm
+            int(f(inp))                        # compile + warm
             t0 = time.perf_counter()
             for _ in range(args.repeats):
-                int(f(w2d if build is build_fused else blk))
+                int(f(inp))
             return (time.perf_counter() - t0) / args.repeats
         t1, tk = timed(1), timed(args.inner)
         return (tk - t1) / (args.inner - 1)
 
-    def build_fused(k):
+    def build_fused(p):
+        def build(k):
+            def f(w):
+                acc = jnp.int32(0)
+                for i in range(k):
+                    _, table, _, _ = cdc_pallas.fused_block(
+                        w ^ jnp.uint32(i), p, interpret)  # salt kills CSE
+                    acc += table[0, cdc_pallas.H_COUNT]
+                return acc
+            return f
+        return build
+
+    def build_scan_only(k):
+        # gear leg: the scan-only kernel shares the gear-map + window-hash
+        # core but does NO cut selection — fused minus this is the select
+        # leg the sequence-based scan targets.
+        R_s = plans["walk"].R
+        T = w2d.shape[0] // R_s
+        pos0 = jnp.zeros((1, 1), jnp.int32)
+        m32 = jnp.full((1, 1), r.mask, jnp.uint32)
+
         def f(w):
             acc = jnp.int32(0)
             for i in range(k):
-                _, table, _, _ = cdc_pallas.fused_block(
-                    w ^ jnp.uint32(i), plan, interpret)  # salt defeats CSE
-                acc += table[0, cdc_pallas.H_COUNT]
+                nib = cdc_pallas._scan_call(T, R_s, n, interpret)(
+                    pos0, m32, w ^ jnp.uint32(i))
+                acc += jnp.sum(nib)
+            return acc
+        return f
+
+    def build_image(k):
+        def f(b):
+            acc = jnp.uint32(0)
+            for i in range(k):
+                acc += jnp.max(resident.be_word_image(b ^ jnp.uint8(i)))
+            return acc
+        return f
+
+    L_pad = 1024
+    ol_np = np.zeros((2, L_pad), dtype=np.int32)
+    ol_np[0] = (np.arange(L_pad) * cdc.min_chunk) % max(n // 2, 1)
+    ol_np[1] = min(cdc.min_chunk, r._b_small * 64 - 9)
+    ol_dev = jax.device_put(ol_np)
+
+    def build_pad(k):
+        def f(w):
+            acc = jnp.uint32(0)
+            for i in range(k):
+                out, _ = resident.sha_pad_messages(
+                    w.reshape(-1) ^ jnp.uint32(i), ol_dev, r._b_small)
+                acc += jnp.max(out)
             return acc
         return f
 
@@ -570,15 +649,34 @@ def bench_cdc(args) -> None:
             return acc
         return f
 
-    fused_ms = measure(build_fused) * 1e3
-    xla_ms = measure(build_xla) * 1e3
+    fused_ms = {name: measure(build_fused(p), w2d) * 1e3
+                for name, p in plans.items()}
+    gear_ms = measure(build_scan_only, w2d) * 1e3
+    image_ms = measure(build_image, blk) * 1e3
+    pad_ms = measure(build_pad, w2d) * 1e3
+    xla_ms = measure(build_xla, blk) * 1e3
+    best = fused_ms.get("skip", fused_ms["walk"])
+    plan = plans.get("skip", plans["walk"])
     print(json.dumps({
-        "op": "cdc_prep [fused pallas vs xla prep, slope A/B]",
+        "op": "cdc_prep [skip-ahead vs pr4 fused vs xla prep, slope A/B]",
         "mb": args.mb, "backend": jax.default_backend(),
         "interpret": interpret,
-        "fused_ms_per_block": round(fused_ms, 3),
+        "mask_bits": cdc.mask_bits, "min_size": cdc.min_chunk,
+        "skip_ahead": bool(args.skip_ahead),
+        "cuts_verified": not overflowed, "overflowed": overflowed,
+        "fused_ms_per_block": round(best, 3),
+        "fused_noskip_ms_per_block": round(fused_ms["walk"], 3),
+        "skip_ahead_speedup": (round(fused_ms["walk"] / best, 3)
+                               if "skip" in fused_ms and best > 0 else None),
         "xla_ms_per_block": round(xla_ms, 3),
-        "speedup": round(xla_ms / fused_ms, 3) if fused_ms > 0 else None,
+        "speedup": round(xla_ms / best, 3) if best > 0 else None,
+        # Per-leg micro-profile (the PERF_NOTES round-17 table): scan =
+        # what cut selection costs on top of the shared gear/hash core.
+        "micro_profile_ms": {"gear": round(max(gear_ms, 0.0), 3),
+                             "scan": round(max(best - gear_ms, 0.0), 3),
+                             "image": round(max(image_ms, 0.0), 3),
+                             "pad": round(max(pad_ms, 0.0), 3)},
+        "scan_slab_survivors": surv, "scan_candidates": cands,
         # Per-block readback ledger: what each shape must await before SHA
         # can be PLACED (XLA: packed candidates -> host select -> offsets
         # re-upload; fused: nothing — the cut table D2H overlaps SHA).
@@ -754,6 +852,14 @@ def main(argv: list[str] | None = None) -> int:
     d.add_argument("--interpret", action="store_true",
                    help="force the fused kernel through the Pallas "
                         "interpreter (correctness-grade timing)")
+    d.add_argument("--mask-bits", type=int, default=13,
+                   help="geometry sweep: expected chunk size 2^mask_bits")
+    d.add_argument("--min-size", type=int, default=2048,
+                   help="geometry sweep: CDC min chunk size (bytes)")
+    d.add_argument("--no-skip-ahead", dest="skip_ahead",
+                   action="store_false",
+                   help="pin the PR 4 fused scan alone (drops the "
+                        "skip-ahead leg of the A/B)")
     d.set_defaults(fn=bench_cdc)
     d = sub.add_parser("multichip")
     d.add_argument("--blocks", type=int, default=64)

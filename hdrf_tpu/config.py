@@ -191,6 +191,20 @@ class ReductionConfig:
     # to land, so one straggling holder never sets read latency.
     # 0 restores the serial holder-by-holder gather.
     ec_read_hedge_delta: int = 1
+    # Content-adaptive chunk sizing (reduction/accounting.py
+    # AdaptiveChunkController): the DN heartbeat observes the dedup
+    # hit/miss counters and retunes cdc_mask_bits/min/max through the
+    # live-reconfig path when a window of commits shows the corpus is
+    # dedup-poor (coarsen) or dedup-rich (walk back toward the target).
+    # Off by default: geometry then stays exactly the static CdcConfig.
+    cdc_adaptive: bool = False
+    # Floor under the controller's emitted min_chunk (the smallest cut
+    # spacing any retune may select; the overflow-cap regression test pins
+    # the fused kernel's fallback at this floor's smallest geometry).
+    cdc_min_size: int = 512
+    # The mask_bits the controller steps back toward when dedup yield is
+    # healthy; 13 reproduces the shipped 2048/65536 geometry exactly.
+    cdc_target_mask_bits: int = 13
     cdc: CdcConfig = field(default_factory=CdcConfig)
 
 

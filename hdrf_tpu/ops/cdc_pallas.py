@@ -20,16 +20,31 @@ trip re-expressing DataDeduplicator.java:264-307).  The kernel fuses, per
 3. **Candidate mask** — ``(h & mask) == 0`` at positions
    ``gear.MIN_CANDIDATE_POS1 <= pos1 <= true_n`` (the shared window-warmup
    convention, gear.py:85-104), reduced to per-word candidate nibbles and a
-   per-row first-candidate summary.
-4. **Cut selection** — the sequential frontier scan of
-   ``hdrf_cdc_select`` (native/src/cdc.cpp:74-92: ``lo = start+min``,
-   ``hi = min(start+max, len)``, first candidate in [lo, hi] else ``hi``)
-   runs as a statically-bounded scalar loop over the summaries, its
-   frontier/counters carried across supertiles in SMEM scratch.  Cuts land
-   in an on-device table; each chunk is also binned (by padded SHA block
-   count) into one of two device-resident offset/length lane tables that
-   feed ``_bucket_sha_best`` (ops/resident.py) with **no host round trip**
-   — the SHA dispatch enqueues before the cut table is ever read back.
+   per-row first-candidate summary.  The skip-ahead variant (default)
+   additionally masks the static min-size dead zone up front:
+   ``pos1 < gear.skip_ahead_threshold(min_chunk)`` can never be selected
+   (every window opens at ``prev+min``), so those candidates never reach
+   the summaries — the SIMD-chunking min-skip of arXiv:2508.05797 mapped
+   onto the 8x128 lane grid.
+4. **Cut selection** — the frontier semantics of ``hdrf_cdc_select``
+   (native/src/cdc.cpp:74-92: ``lo = start+min``,
+   ``hi = min(start+max, len)``, first candidate in [lo, hi] else ``hi``).
+   PR 4's scan walked the summaries word-by-word per cut
+   (O(candidate words) SMEM trips).  The sequence-based select (the
+   arXiv:2505.21194 two-phase trick, default on) instead reduces each
+   supertile's per-word first-candidate array to VECTORIZED suffix-min
+   summaries — within-row (lane log-doubling rolls) and cross-row over
+   the two-slab window — so the per-cut walk collapses to O(1): one
+   nibble resolve in ``lo``'s own word, one within-row suffix read, one
+   cross-row suffix read.  Frontier/counters still carry across
+   supertiles in SMEM scratch; cuts land in an on-device table; each
+   chunk is binned (by padded SHA block count) into one of two
+   device-resident offset/length lane tables that feed
+   ``_bucket_sha_best`` (ops/resident.py) with **no host round trip** —
+   the SHA dispatch enqueues before the cut table is ever read back.
+   ``FusedPlan.skip_ahead`` statically selects the variant, so the PR 4
+   scan remains compilable as the A/B baseline
+   (``benchmarks cdc --no-skip-ahead``).
 
 The kernel additionally emits the big-endian word image (in-kernel byteswap
 of the LE words — the separate ``be_word_image`` MXU pass of
@@ -62,9 +77,15 @@ WINDOW = gear.WINDOW
 _GOLD = np.uint32(0x9E3779B1)
 _INF = np.int32(0x7FFFFFFF)
 
-# Header lanes at the front of the cut table readback.
+# Header lanes at the front of the cut table readback.  H_SURV / H_CANDS
+# are the sequence-select telemetry lanes (zero under the PR 4 scan):
+# slab survivors = rows whose first-candidate summary is finite (the
+# per-slab survivor list the two-phase select reduces the scan to),
+# candidates = masked candidate population that survived the skip-ahead
+# dead zone.
 TABLE_HDR = 8
 H_COUNT, H_OVERFLOW, H_SMALL, H_BIG = 0, 1, 2, 3
+H_SURV, H_CANDS = 4, 5
 
 
 def cdc_pallas_mode() -> str:
@@ -82,6 +103,17 @@ def cdc_pallas_mode() -> str:
     if env == "1":  # forcing the fused path without a chip = interpreter
         return "interpret"
     return "off"
+
+
+def cdc_skip_ahead() -> bool:
+    """Static gate for the skip-ahead + sequence-select scan variant
+    (ISSUE 15 tentpole; arXiv:2505.21194's two-phase select).  Default on;
+    ``HDRF_CDC_SKIP_AHEAD=0`` pins the PR 4 sequential frontier scan — the
+    A/B baseline ``benchmarks cdc`` sweeps.  Like ``cdc_pallas_mode`` it is
+    resolved once per reducer construction (ops/resident.py:224) so a
+    mid-process flip selects a different cached reducer instead of
+    mutating one."""
+    return os.environ.get("HDRF_CDC_SKIP_AHEAD", "1") != "0"
 
 
 # --------------------------------------------------------------------------
@@ -107,22 +139,40 @@ class FusedPlan:
     mask: int
     min_chunk: int
     max_chunk: int
+    skip_ahead: bool = True   # sequence-select scan (False = PR 4 scan)
 
 
 def plan_for(true_n: int, mask: int, mask_bits: int, min_chunk: int,
-             max_chunk: int, b_small: int, b_big: int) -> FusedPlan:
+             max_chunk: int, b_small: int, b_big: int,
+             skip_ahead: bool | None = None) -> FusedPlan:
     """Shape plan: supertile >= max_chunk so a chunk search window spans at
     most two tiles (the revisited two-slab scratch); cut capacity =
     min(hard bound n/min_chunk, ~2x the expected chunk count) — the
     distributional cap is what a pathological low-entropy block overflows
-    into the XLA fallback."""
+    into the XLA fallback.
+
+    Under ``skip_ahead`` the distributional cap accounts for the min-size
+    dead zone (the ISSUE 15 overflow-header fix): cuts renew at least
+    ``min_chunk`` apart before the geometric candidate wait, so the
+    expected count follows the renewal spacing ``min_chunk + 2^mask_bits``
+    rather than the raw candidate density — never LOOSER than the PR 4
+    cap, so every corpus that overflowed into the XLA fallback before
+    (zeros at any controller-emitted geometry included) still does
+    (regression-pinned at the controller's smallest min-size in
+    tests/test_cdc_pallas.py)."""
+    if skip_ahead is None:
+        skip_ahead = cdc_skip_ahead()
     min_chunk = max(1, min_chunk)
     R = -(-max(65536, max_chunk) // 512)
     R = -(-R // 8) * 8
     B = R * 512
     n_pad = true_n + (-true_n) % B
     hard = true_n // min_chunk + 2
-    distr = 2 * (true_n >> max(mask_bits, 0)) + 1024
+    if skip_ahead:
+        spacing = min_chunk + (1 << min(max(mask_bits, 0), 30))
+        distr = 2 * (true_n // spacing) + 1024
+    else:
+        distr = 2 * (true_n >> max(mask_bits, 0)) + 1024
     cap = max(2, min(hard, distr))
     bs = max(1, min(b_small, b_big))
     big_min_len = max(bs * 64 - 72, 1)
@@ -130,7 +180,7 @@ def plan_for(true_n: int, mask: int, mask_bits: int, min_chunk: int,
     return FusedPlan(true_n=true_n, n_pad=n_pad, R=R, T=n_pad // B,
                      cap=cap, Ls=_r128(cap), Lb=Lb, b_small=bs, b_big=b_big,
                      mask=mask & 0xFFFFFFFF, min_chunk=min_chunk,
-                     max_chunk=max_chunk)
+                     max_chunk=max_chunk, skip_ahead=bool(skip_ahead))
 
 
 # --------------------------------------------------------------------------
@@ -197,7 +247,11 @@ def _tile_hashes(w, hist_ref):
 # --------------------------------------------------------------------------
 
 def _select_kernel(w_ref, wbe_ref, table_ref, ols_ref, olb_ref,
-                   cmask_ref, rfc_ref, hist_ref, st_ref, *, p: FusedPlan):
+                   cmask_ref, rfc_ref, *scratch, p: FusedPlan):
+    if p.skip_ahead:
+        wsx_ref, rsx_ref, hist_ref, st_ref = scratch
+    else:
+        hist_ref, st_ref = scratch
     R, cap, Ls, Lb = p.R, p.cap, p.Ls, p.Lb
     B = R * 512
     t = pl.program_id(0)
@@ -214,11 +268,15 @@ def _select_kernel(w_ref, wbe_ref, table_ref, ols_ref, olb_ref,
         olb_ref[...] = jnp.zeros_like(olb_ref)
         cmask_ref[...] = jnp.zeros_like(cmask_ref)
         rfc_ref[...] = jnp.full_like(rfc_ref, _INF)
+        if p.skip_ahead:
+            wsx_ref[...] = jnp.full_like(wsx_ref, _INF)
 
     @pl.when(t > 0)
     def _slide():  # two-tile window: current tile -> slab 1, previous -> 0
         cmask_ref[0] = cmask_ref[1]
         rfc_ref[0] = rfc_ref[1]
+        if p.skip_ahead:
+            wsx_ref[0] = wsx_ref[1]
 
     w = w_ref[...]
     # In-kernel BE word image (replaces the separate MXU combine pass).
@@ -232,16 +290,53 @@ def _select_kernel(w_ref, wbe_ref, table_ref, ols_ref, olb_ref,
     word_g = t * (R * 128) + row * 128 + lane
     pos0 = word_g * 4 + 1                       # pos1 of phase 0
     mask = u(p.mask)
+    # Skip-ahead dead zone: positions below gear.skip_ahead_threshold can
+    # never be selected (every window opens at prev+min), so masking them
+    # here is cut-identical and keeps dead candidates out of every summary
+    # the select walks or jumps over.
+    thr = (gear.skip_ahead_threshold(p.min_chunk) if p.skip_ahead
+           else gear.MIN_CANDIDATE_POS1)
     cand, fc = [], jnp.full((R, 128), _INF, i32)
     for ph in range(4):
         pos = pos0 + ph
-        c = ((h[ph] & mask) == 0) & (pos >= gear.MIN_CANDIDATE_POS1) \
-            & (pos <= p.true_n)
+        c = ((h[ph] & mask) == 0) & (pos >= thr) & (pos <= p.true_n)
         cand.append(c.astype(i32))
         fc = jnp.minimum(fc, jnp.where(c, pos, _INF))
     cmask_ref[1] = (cand[0] | (cand[1] << 1) | (cand[2] << 2)
                     | (cand[3] << 3))
-    rfc_ref[1] = jnp.min(fc, axis=1, keepdims=True)
+    row_min = jnp.min(fc, axis=1, keepdims=True)
+    rfc_ref[1] = row_min
+
+    if p.skip_ahead:
+        # ---- phase 1 of the sequence-based select: vectorized suffix-min
+        # summaries.  wsx[r, l] = min first-candidate over lanes l.. of row
+        # r (7 log-doubling rolls; pltpu.roll is circular, so wrapped lanes
+        # are masked to _INF before each min).  rsx[sr] = min row summary
+        # over window rows sr.. of the two-slab window (recomputed per tile
+        # from the slid + fresh row summaries).  Together they make the
+        # per-cut frontier lookup O(1) in place of the PR 4 word walk.
+        sfx = fc
+        step = 1
+        while step < 128:
+            y = pltpu.roll(sfx, 128 - step, 1)
+            sfx = jnp.minimum(sfx, jnp.where(lane < 128 - step, y, _INF))
+            step *= 2
+        wsx_ref[1] = sfx
+        rwin = jnp.concatenate([rfc_ref[0], row_min], axis=0)
+        rowi2 = jax.lax.broadcasted_iota(i32, (2 * R, 1), 0)
+        rsx = rwin
+        step = 1
+        while step < 2 * R:
+            y = pltpu.roll(rsx, 2 * R - step, 0)
+            rsx = jnp.minimum(rsx, jnp.where(rowi2 < 2 * R - step, y, _INF))
+            step *= 2
+        rsx_ref[...] = rsx
+        # Telemetry for the H_SURV/H_CANDS header lanes (benchmarks cdc /
+        # bench.py's cdc_adaptive block): per-slab survivor count = rows
+        # with any viable candidate, plus the masked candidate population.
+        st_ref[6] = st_ref[6] + jnp.sum((row_min != _INF).astype(i32))
+        st_ref[7] = st_ref[7] + jnp.sum(cand[0] + cand[1]
+                                        + cand[2] + cand[3])
 
     # ---- sequential frontier scan over the two-slab candidate summaries
     base_row = (t - 1) * R
@@ -265,7 +360,30 @@ def _select_kernel(w_ref, wbe_ref, table_ref, ols_ref, olb_ref,
             best = jnp.where(hit, pos, best)
         return best
 
-    def find(lo, hi):
+    def find_seq(lo, hi):
+        """Phase 2 of the sequence-based select: first candidate >= ``lo``
+        in O(1).  ``lo``'s own word resolves by nibble; later words of the
+        row come from the within-row suffix-min at ``lane_lo + 1``; later
+        rows from the cross-row suffix-min at ``sr + 1``.  Positions in
+        words past ``lo``'s are provably >= 4*j_lo + 5 > lo, so the
+        suffix reads never surface a pre-``lo`` candidate; a result past
+        ``hi`` means "no candidate in window" and the caller's
+        ``cpos <= hi`` clamp forces the cut at ``hi`` — identical
+        semantics to the PR 4 walk below."""
+        j_lo = (lo - 1) // 4
+        row_lo = j_lo // 128
+        lane_lo = j_lo % 128
+        sr = jnp.clip(row_lo - base_row, 0, 2 * R - 1)
+        inf = jnp.full((), _INF, i32)
+        a = first_in_word(j_lo, lo, inf)
+        b = jnp.where(lane_lo < 127,
+                      wsx_ref[sr // R, sr % R,
+                              jnp.clip(lane_lo + 1, 0, 127)], inf)
+        c = jnp.where(sr < 2 * R - 1,
+                      rsx_ref[jnp.clip(sr + 1, 0, 2 * R - 1), 0], inf)
+        return jnp.minimum(a, jnp.minimum(b, c))
+
+    def find_walk(lo, hi):
         """First candidate pos1 in [lo, hi] (else _INF) via the summaries:
         whole rows skip on the per-row first-candidate value; only the
         partial row containing ``lo`` word-scans."""
@@ -300,6 +418,8 @@ def _select_kernel(w_ref, wbe_ref, table_ref, ols_ref, olb_ref,
         _, best, _ = jax.lax.fori_loop(
             0, trips, rbody, (r0, best0, jnp.full((), 0, i32)))
         return best
+
+    find = find_seq if p.skip_ahead else find_walk
 
     def cbody(i, s):
         f, nc, ns, nbg, of, done = s
@@ -346,6 +466,8 @@ def _select_kernel(w_ref, wbe_ref, table_ref, ols_ref, olb_ref,
         table_ref[0, H_OVERFLOW] = of
         table_ref[0, H_SMALL] = ns
         table_ref[0, H_BIG] = nbg
+        table_ref[0, H_SURV] = st_ref[6]
+        table_ref[0, H_CANDS] = st_ref[7]
 
 
 @functools.cache
@@ -364,9 +486,12 @@ def _select_call(p: FusedPlan, interpret: bool):
                    jax.ShapeDtypeStruct((2, p.Ls), jnp.int32),
                    jax.ShapeDtypeStruct((2, p.Lb), jnp.int32)],
         scratch_shapes=[pltpu.VMEM((2, R, 128), jnp.int32),
-                        pltpu.VMEM((2, R, 1), jnp.int32),
-                        pltpu.VMEM((16, 128), jnp.uint32),
-                        pltpu.SMEM((8,), jnp.int32)],
+                        pltpu.VMEM((2, R, 1), jnp.int32)]
+        + ([pltpu.VMEM((2, R, 128), jnp.int32),     # wsx: within-row sfx-min
+            pltpu.VMEM((2 * R, 1), jnp.int32)]      # rsx: cross-row sfx-min
+           if p.skip_ahead else [])
+        + [pltpu.VMEM((16, 128), jnp.uint32),
+           pltpu.SMEM((8,), jnp.int32)],
         interpret=interpret,
     )
 
@@ -384,11 +509,15 @@ def fused_block(w2d: jax.Array, p: FusedPlan, interpret: bool):
 
 def chunks_fused(data: bytes | np.ndarray, mask: int, min_chunk: int,
                  max_chunk: int, *, mask_bits: int = 13,
-                 interpret: bool | None = None):
+                 interpret: bool | None = None,
+                 skip_ahead: bool | None = None):
     """(cuts, overflowed) with selection fully on device; same cut contract
     as native.cdc_chunk (asserted bit-identical in tests/test_cdc_pallas.py).
     ``overflowed`` reports that cap was exceeded and cuts are INVALID —
-    callers must take the oracle path (the resident pipeline's fallback)."""
+    callers must take the oracle path (the resident pipeline's fallback).
+    ``skip_ahead`` pins the scan variant (None = the process-level
+    ``cdc_skip_ahead()`` gate) — both variants must produce identical cuts,
+    which the A/B tests sweep."""
     a = (np.frombuffer(data, dtype=np.uint8)
          if not isinstance(data, np.ndarray) else data)
     if a.size == 0:
@@ -396,7 +525,7 @@ def chunks_fused(data: bytes | np.ndarray, mask: int, min_chunk: int,
     if interpret is None:
         interpret = cdc_pallas_mode() != "mosaic"
     p = plan_for(a.size, mask, mask_bits, min_chunk, max_chunk,
-                 b_small=1 << 30, b_big=1 << 30)
+                 b_small=1 << 30, b_big=1 << 30, skip_ahead=skip_ahead)
     buf = np.zeros(p.n_pad, dtype=np.uint8)
     buf[:a.size] = a
     w2d = jax.device_put(buf.view(np.uint32).reshape(-1, 128))

@@ -170,26 +170,35 @@ def chunk_and_fingerprint(data: bytes | np.ndarray, cdc: CdcConfig,
     candidate scan with ICI halo exchange + chunk-parallel SHA lanes over
     every chip.  The native path is the CPU baseline pair of calls.
     """
+    from hdrf_tpu.reduction import accounting
+
     nbytes = len(data) if isinstance(data, (bytes, bytearray)) else data.nbytes
     _M.incr(f"reduce_{backend}_total")
     _M.incr(f"reduce_{backend}_bytes", nbytes)
+    # Effective-geometry gauges: under the adaptive controller the cdc
+    # object mutates between calls, and this is the one funnel every
+    # reduction passes through.
+    accounting.note_geometry(cdc)
     if backend == "tpu":
         mesh = _multichip_mesh()
         if mesh is not None:
             from hdrf_tpu.parallel.sharded import reduce_sharded
 
             return reduce_sharded(data, cdc, mesh)
-        from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode
+        from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode, cdc_skip_ahead
         from hdrf_tpu.ops.resident import ResidentReducer
 
-        # The fused-CDC mode is part of the key: a reducer pins its mode at
-        # construction (jit-cache coherence), so flipping HDRF_CDC_PALLAS
-        # mid-process must select a different reducer, not mutate one.
+        # The fused-CDC mode and scan variant are part of the key: a
+        # reducer pins both at construction (jit-cache coherence), so
+        # flipping HDRF_CDC_PALLAS / HDRF_CDC_SKIP_AHEAD mid-process — or
+        # an adaptive-controller retune mutating ``cdc`` — must select a
+        # different reducer, not mutate one.
         key = (cdc.mask_bits, cdc.min_chunk, cdc.max_chunk,
-               cdc_pallas_mode())
+               cdc_pallas_mode(), cdc_skip_ahead())
         r = _resident_cache.get(key)
         if r is None:
-            r = _resident_cache[key] = ResidentReducer(cdc, fused_mode=key[3])
+            r = _resident_cache[key] = ResidentReducer(
+                cdc, fused_mode=key[3], skip_ahead=key[4])
         return r.reduce(data)
     # Native CDC+SHA run synchronously on the host, so they are a host
     # phase; the jax paths above must NOT be wrapped here — their wall time

@@ -42,6 +42,20 @@ WINDOW = 32  # bytes contributing to the hash: h[i] covers b[i-31..i]
 MIN_CANDIDATE_POS1 = WINDOW
 
 
+def skip_ahead_threshold(min_chunk: int) -> int:
+    """Smallest pos1 a candidate must reach to ever be SELECTABLE under a
+    ``min_chunk`` floor.  Every selection window opens at
+    ``prev_cut + min_chunk`` (native/src/cdc.cpp:74-92's ``lo``) and
+    ``prev_cut >= 0``, so a candidate below
+    ``max(MIN_CANDIDATE_POS1, min_chunk)`` is dead on arrival regardless of
+    block content.  The skip-ahead kernels (ops/cdc_pallas.py) and the mesh
+    plane (parallel/sharded.py) mask such candidates out of candidate
+    generation up front — provably cut-identical, because the frontier scan
+    could never have picked them.  The XLA scan here stays verbatim: it is
+    the all-geometry bit-identity oracle."""
+    return max(MIN_CANDIDATE_POS1, int(min_chunk))
+
+
 def _fmix32_np(z: np.ndarray) -> np.ndarray:
     z = z.astype(np.uint32)
     z ^= z >> np.uint32(16)

@@ -342,8 +342,9 @@ class ResidentReducer:
     """
 
     def __init__(self, cdc: CdcConfig | None = None,
-                 fused_mode: str | None = None):
-        from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode
+                 fused_mode: str | None = None,
+                 skip_ahead: bool | None = None):
+        from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode, cdc_skip_ahead
 
         self.cdc = cdc or CdcConfig()
         self.mask = gear_mask(self.cdc)
@@ -351,6 +352,10 @@ class ResidentReducer:
         # cache stays coherent; dispatch.py keys its reducer cache on this.
         self.fused = fused_mode if fused_mode is not None \
             else cdc_pallas_mode()
+        # Scan-variant pin (skip-ahead + sequence select vs the PR 4 walk),
+        # resolved once for the same jit-cache-coherence reason.
+        self._skip_ahead = skip_ahead if skip_ahead is not None \
+            else cdc_skip_ahead()
         # Gather windows must never clamp: pad the word image by the widest
         # bucket (max_chunk rounded up) + the funnel-shift lookahead word,
         # rounded to the 128-word row grid the Pallas DMA gather requires.
@@ -467,7 +472,8 @@ class ResidentReducer:
             k, w3d = len(arrs), None
         plan = cdc_pallas.plan_for(true_n, self.mask, self.cdc.mask_bits,
                                    self.cdc.min_chunk, self.cdc.max_chunk,
-                                   self._b_small, self._b_big)
+                                   self._b_small, self._b_big,
+                                   skip_ahead=self._skip_ahead)
         stride = plan.n_pad + 4 * self.pad_words
         assert k * stride < (1 << 31), \
             "batch too large for i32 flat offsets; split it"
@@ -536,6 +542,14 @@ class ResidentReducer:
         _ledger.readback(bj._ev, d2h_bytes=tables.nbytes)
         bj._ev = None
         bj.tables = None
+        if self._skip_ahead:
+            # Sequence-select telemetry rides the header lanes of the one
+            # readback that already happens — zero extra D2H.
+            from hdrf_tpu.reduction import accounting
+
+            accounting.record_scan_summary(
+                int(tables[:, cp.H_SURV].sum()),
+                int(tables[:, cp.H_CANDS].sum()))
         if tables[:, cp.H_OVERFLOW].any():
             for ev in bj._ev_sha or ():       # fused SHA results discarded
                 _ledger.readback(ev, d2h_bytes=0)
@@ -662,7 +676,8 @@ class ResidentReducer:
                                        self.cdc.mask_bits,
                                        self.cdc.min_chunk,
                                        self.cdc.max_chunk,
-                                       self._b_small, self._b_big)
+                                       self._b_small, self._b_big,
+                                       skip_ahead=self._skip_ahead)
             stride = max(stride, plan.n_pad + 4 * self.pad_words)
         return max(1, min(((1 << 31) - 1) // stride, 16))
 

@@ -373,7 +373,26 @@ def reduce_sharded(data: bytes | np.ndarray, cdc, mesh: Mesh):
     wv = _fetch_global(words)
     _ledger.readback(ev, d2h_bytes=wv.nbytes)
     (idx,) = np.nonzero(wv)
-    pos = gear._words_to_positions(idx.astype(np.uint32), wv[idx], n)
+    vals = wv[idx]
+    # Skip-ahead dead-zone filter (gear.skip_ahead_threshold): candidates
+    # below max(WINDOW, min_chunk) can never be selected — every window
+    # opens at prev+min — so dropping them before the unpack+select walk
+    # is provably cut-identical and shrinks the O(candidates) host leg.
+    # Applied to the SPARSE (idx, vals) pairs, not the dense bitmap (the
+    # fetched word image may be a read-only view of device memory); the
+    # packed-bitmap D2H contract above stays untouched (the scan-only
+    # kernel and gear_candidates_sharded keep their bit-identity tests).
+    thr = gear.skip_ahead_threshold(cdc.min_chunk)
+    if thr > gear.MIN_CANDIDATE_POS1 and idx.size:
+        w_t, rem = divmod(thr - 1, 32)
+        keep = idx >= w_t
+        if rem:
+            at = np.nonzero(idx == w_t)[0]
+            if at.size:
+                vals[at] &= np.uint32((0xFFFFFFFF << rem) & 0xFFFFFFFF)
+                keep[at] = vals[at] != 0
+        idx, vals = idx[keep], vals[keep]
+    pos = gear._words_to_positions(idx.astype(np.uint32), vals, n)
     cuts = native.cdc_select(pos, n, cdc.min_chunk, cdc.max_chunk)
     starts = np.concatenate([[0], cuts[:-1]]).astype(np.int64)
     lens = (cuts - starts).astype(np.int64)
@@ -590,8 +609,22 @@ def _mesh_step(mesh: Mesh, Kl: int, n_pad: int, mn: int, mx: int,
 
         return sha256_words_pallas(msgs, nb.astype(jnp.int32))
 
+    # Static skip-ahead word mask (gear.skip_ahead_threshold): bitmap words
+    # wholly below max(WINDOW, min_chunk) carry only dead candidates (every
+    # select window opens at prev+min), so ANDing them out is provably
+    # cut-identical and lets the select scan's first windows skip over
+    # guaranteed-empty words.  Static per geometry — part of this fn's
+    # cache key already (``mn``).
+    _thr = gear.skip_ahead_threshold(mn)
+    _wt, _rem = divmod(_thr - 1, 32)
+    _wmask = np.full(n_pad // 32, 0xFFFFFFFF, np.uint32)
+    _wmask[:min(_wt, _wmask.size)] = 0
+    if _rem and _wt < _wmask.size:
+        _wmask[_wt] = (0xFFFFFFFF << _rem) & 0xFFFFFFFF
+
     def step(blocks, tns, mask, table):
         cw = jax.vmap(lambda b: gear.candidate_bitmap_words(b, mask))(blocks)
+        cw = cw & jnp.asarray(_wmask)[None, :]
         cuts, counts = jax.vmap(
             lambda w, t: _select_cuts_dev(w, t, mn, mx, cap))(cw, tns)
         starts = jnp.concatenate(

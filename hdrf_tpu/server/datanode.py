@@ -294,6 +294,16 @@ class DataNode:
             # seal compression off the commit critical path too: an
             # unlucky rollover must not stall the blocks queued behind it
             self.containers.enable_async_seals()
+        # Content-adaptive chunk sizing (reduction/accounting.py
+        # AdaptiveChunkController): the heartbeat tick feeds it the dedup
+        # hit/miss counters; the steps it emits are applied through
+        # reconfigure() — the same validated path an operator would use —
+        # so geometry never changes behind the config's audit trail.
+        self._cdc_controller = None
+        if red.cdc_adaptive:
+            self._cdc_controller = accounting.AdaptiveChunkController(
+                target_mask_bits=red.cdc_target_mask_bits,
+                min_size=red.cdc_min_size)
         # Admission control: bounded slots instead of ticket queues.
         self._write_sem = threading.Semaphore(red.max_concurrent_writes)
         self._read_sem = threading.Semaphore(red.max_concurrent_reads)
@@ -865,6 +875,7 @@ class DataNode:
 
         while not self._stop.wait(interval):
             fault_injection.point("datanode.heartbeat", dn_id=self.dn_id)
+            self._cdc_tick()
             stats = self._stats()
             for nn in self._nns:
                 try:
@@ -889,6 +900,28 @@ class DataNode:
                 except (OSError, ConnectionError):
                     _M.incr("heartbeat_failures")
                 last_report = now
+
+    def _cdc_tick(self) -> None:
+        """Adaptive-chunking control step (heartbeat cadence): feed the
+        controller the cumulative dedup counters; apply whatever ordered
+        reconfigure steps it emits through the SAME validated reconfigure
+        path an operator uses.  A rejected step (bounds, transient
+        min>max the ordering should have prevented) abandons the retune —
+        the controller re-decides next window from fresh evidence."""
+        ctl = self._cdc_controller
+        if ctl is None:
+            return
+        hit, miss = accounting.dedup_counters()
+        cdc = self.reduction_ctx.config.cdc
+        steps = ctl.observe(hit, miss, cdc.mask_bits)
+        for key, value in steps:
+            r = self.reconfigure(key, value)
+            if not r.get("ok"):
+                _M.incr("cdc_retune_rejected")
+                self._log.warning("cdc retune step %s=%s rejected: %s",
+                                  key, value, r.get("error"))
+                return
+            accounting.record_retune(key, r["old"], r["new"])
 
     def _lifeline_loop(self) -> None:
         """DatanodeLifelineProtocol analog: a LOW-COST liveness-only
@@ -1223,13 +1256,51 @@ class DataNode:
         "scan_interval_s", "volume_check_interval_s",
         "block_report_interval_s", "cache_capacity",
         "balancer_bandwidth", "scrub_interval_s",
+        "cdc_mask_bits", "cdc_min_chunk", "cdc_max_chunk",
     })
+
+    # Live CDC geometry: bounds mirror AdaptiveChunkController's emit
+    # range plus headroom for operator-driven reconfigures; the min<=max
+    # invariant is checked against the OTHER live field so a retune
+    # sequence must order its steps (accounting.py steps()).
+    _CDC_BOUNDS = {"cdc_mask_bits": (6, 20),
+                   "cdc_min_chunk": (32, 1 << 22),
+                   "cdc_max_chunk": (64, 1 << 24)}
+
+    def _reconfigure_cdc(self, key: str, value) -> dict:
+        """Apply a live CDC-geometry change to the SHARED CdcConfig (the
+        write pipeline and dispatch funnel hold the same object, so new
+        cuts pick it up on their next reducer resolution; committed
+        fingerprints are content-addressed and stay valid —
+        ARCHITECTURE.md decision 15)."""
+        cdc = self.reduction_ctx.config.cdc
+        field = key[len("cdc_"):]
+        old = getattr(cdc, field)
+        try:
+            cast = int(value)
+        except (TypeError, ValueError) as e:
+            return {"ok": False, "error": f"bad value for {key}: {e}"}
+        lo, hi = self._CDC_BOUNDS[key]
+        if not lo <= cast <= hi:
+            return {"ok": False,
+                    "error": f"{key}={cast} outside [{lo}, {hi}]"}
+        mn = cast if field == "min_chunk" else cdc.min_chunk
+        mx = cast if field == "max_chunk" else cdc.max_chunk
+        if mn > mx:
+            return {"ok": False,
+                    "error": f"{key}={cast} would leave min_chunk={mn} > "
+                             f"max_chunk={mx}; reorder the steps"}
+        setattr(cdc, field, cast)
+        _M.incr("reconfigurations")
+        return {"ok": True, "key": key, "old": old, "new": cast}
 
     def reconfigure(self, key: str, value) -> dict:
         if key not in self.RECONFIGURABLE:
             return {"ok": False,
                     "error": f"'{key}' is not reconfigurable "
                              f"(allowed: {sorted(self.RECONFIGURABLE)})"}
+        if key.startswith("cdc_"):
+            return self._reconfigure_cdc(key, value)
         old = getattr(self.config, key)
         try:
             cast = type(old)(value)

@@ -169,16 +169,20 @@ class WritePipeline:
 
     def _reducer(self):
         """The dispatch-cache ResidentReducer for this cdc config (shared
-        jit cache with the per-block chunk_and_fingerprint path)."""
-        from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode
+        jit cache with the per-block chunk_and_fingerprint path; same key
+        shape as ops/dispatch.py chunk_and_fingerprint, including the
+        scan-variant flag — an adaptive retune mutating ``self._cdc``
+        therefore resolves to a DIFFERENT cached reducer, never mutates a
+        constructed one)."""
+        from hdrf_tpu.ops.cdc_pallas import cdc_pallas_mode, cdc_skip_ahead
         from hdrf_tpu.ops.resident import ResidentReducer
 
         key = (self._cdc.mask_bits, self._cdc.min_chunk,
-               self._cdc.max_chunk, cdc_pallas_mode())
+               self._cdc.max_chunk, cdc_pallas_mode(), cdc_skip_ahead())
         r = dispatch._resident_cache.get(key)
         if r is None:
             r = dispatch._resident_cache[key] = ResidentReducer(
-                self._cdc, fused_mode=key[3])
+                self._cdc, fused_mode=key[3], skip_ahead=key[4])
         return r
 
     def _drain(self, block: bool) -> tuple[list[_Item], bool]:
@@ -202,13 +206,16 @@ class WritePipeline:
         return items, False
 
     def _coalesce_loop(self) -> None:
-        # The mesh plane supersedes the single-device reducer when present:
-        # same submit/start/finish protocol, one dispatch per mesh step.
-        r = self.mesh_reducer or self._reducer()
-        # (BatchJob, members): submitted (enqueued) but not yet finished
+        # The mesh plane supersedes the single-device reducer when present
+        # (same submit/start/finish protocol, one dispatch per mesh step)
+        # and stays PINNED at its construction geometry — its bucket table
+        # holds device state no retune may invalidate.  The single-device
+        # reducer is re-resolved per round instead, so an adaptive retune
+        # of the shared CdcConfig takes effect at the next group.
         inflight: deque = deque()
         stopping = False
         while True:
+            r = self.mesh_reducer or self._reducer()
             if not stopping:
                 items, stopping = self._drain(block=not inflight)
                 for group in self._group(r, items):

@@ -224,7 +224,14 @@ def main(argv: list[str] | None = None) -> int:
                 + check_bench_contract(root, key="qos")
                 + check_bench_contract(root, key="qos.sheds")
                 + check_bench_contract(root, key="qos.tenant_fairness_ratio")
-                + check_bench_contract(root, key="qos.ec_hedge_wins"))
+                + check_bench_contract(root, key="qos.ec_hedge_wins")
+                + check_bench_contract(root, key="cdc_adaptive")
+                + check_bench_contract(root, key="cdc_adaptive.skip_ahead")
+                + check_bench_contract(
+                    root, key="cdc_adaptive.scan_slab_survivors")
+                + check_bench_contract(
+                    root, key="cdc_adaptive.mask_bits_effective")
+                + check_bench_contract(root, key="cdc_adaptive.retunes"))
     for p in problems:
         print(p)
     print(f"{len(problems)} violation(s)" if problems

@@ -1,0 +1,217 @@
+"""Adaptive chunk sizing (ISSUE 15 leg 3): the AdaptiveChunkController's
+decisions (reduction/accounting.py), the DataNode live-reconfig path that
+applies them (server/datanode.py _reconfigure_cdc / _cdc_tick), and the
+end-to-end loop — dedup-poor evidence coarsens the live geometry while
+data committed under the OLD geometry reads back bit-identical (the
+content-addressed-fingerprint safety argument, ARCHITECTURE.md decision
+15).  The oracle property test pins EVERY geometry the controller can
+emit against native.cdc_chunk through both the XLA scan and the fused
+Pallas kernel, so no retune can steer cuts onto an unverified shape.
+"""
+
+import numpy as np
+
+from hdrf_tpu import native
+from hdrf_tpu.config import CdcConfig
+from hdrf_tpu.ops import cdc_pallas, gear
+from hdrf_tpu.ops.dispatch import gear_mask
+from hdrf_tpu.reduction import accounting
+from hdrf_tpu.reduction.accounting import AdaptiveChunkController
+
+
+# ------------------------------------------------------- controller decisions
+
+
+class TestController:
+    def test_defaults_reproduce_shipped_geometry(self):
+        """Enabling the controller must be a no-op until evidence moves
+        it: the default target reproduces CdcConfig's 2048/65536."""
+        ctl = AdaptiveChunkController()
+        cdc = CdcConfig()
+        assert ctl.geometry(ctl.target) == (cdc.min_chunk, cdc.max_chunk)
+
+    def test_window_gating_no_decision_on_thin_evidence(self):
+        ctl = AdaptiveChunkController(window_chunks=512)
+        assert ctl.observe(10, 100, 13) == []          # 110 < 512
+        assert ctl.observe(20, 200, 13) == []          # 330 < 512
+        # the window accumulates across calls: crossing it decides
+        steps = ctl.observe(20, 500, 13)
+        assert steps                                    # 720 >= 512, poor
+
+    def test_coarsen_on_dedup_poor_and_step_order(self):
+        ctl = AdaptiveChunkController(window_chunks=64)
+        steps = ctl.observe(0, 64, 13)                  # ratio 0 < LOW_HIT
+        mn, mx = ctl.geometry(14)
+        # growing: max first, then min, mask bits last — min<=max holds at
+        # every intermediate state starting from geometry(13)
+        assert steps == [("cdc_max_chunk", mx), ("cdc_min_chunk", mn),
+                         ("cdc_mask_bits", 14)]
+
+    def test_refine_toward_target_when_dedup_rich(self):
+        ctl = AdaptiveChunkController(target_mask_bits=13, window_chunks=64)
+        steps = ctl.observe(40, 24, 15)                 # ratio > HIGH_HIT
+        mn, mx = ctl.geometry(14)
+        # shrinking: min first, then max
+        assert steps == [("cdc_min_chunk", mn), ("cdc_max_chunk", mx),
+                         ("cdc_mask_bits", 14)]
+
+    def test_no_move_at_target_or_midband(self):
+        ctl = AdaptiveChunkController(window_chunks=64)
+        assert ctl.observe(40, 24, 13) == []            # rich AND at target
+        ctl2 = AdaptiveChunkController(window_chunks=64)
+        assert ctl2.observe(10, 54, 13) == []           # mid-band ratio
+
+    def test_clamped_at_mask_bits_max(self):
+        ctl = AdaptiveChunkController(window_chunks=64)
+        assert ctl.observe(0, 64, ctl.MASK_BITS_MAX) == []
+
+    def test_counter_reset_restarts_window(self):
+        ctl = AdaptiveChunkController(window_chunks=64)
+        assert ctl.observe(0, 60, 13) == []
+        # process restart: cumulative counters went BACKWARD; the partial
+        # window is discarded rather than polluted with a bogus delta
+        assert ctl.observe(0, 10, 13) == []
+        assert ctl._win_hit == ctl._win_miss == 0
+        assert ctl.observe(0, 30, 13) == []             # 20 new misses only
+
+    def test_steps_keep_min_le_max_at_every_intermediate(self):
+        """Property over every (old, new) pair in the emit range: applying
+        the ordered steps one at a time never passes through a state with
+        min_chunk > max_chunk — the invariant _reconfigure_cdc enforces,
+        so a mis-ordered plan would strand the retune halfway."""
+        ctl = AdaptiveChunkController()
+        lo, hi = ctl.MASK_BITS_MIN, ctl.MASK_BITS_MAX
+        for old in range(lo, hi + 1):
+            for new in range(lo, hi + 1):
+                if old == new:
+                    continue
+                state = dict(zip(("min_chunk", "max_chunk"),
+                                 ctl.geometry(old)))
+                for key, value in ctl.steps(old, new):
+                    field = key[len("cdc_"):]
+                    if field in state:
+                        state[field] = value
+                    assert state["min_chunk"] <= state["max_chunk"], \
+                        (old, new, key)
+                assert state == dict(zip(("min_chunk", "max_chunk"),
+                                         ctl.geometry(new)))
+
+
+# ------------------------------------------- oracle pin over the emit range
+
+
+def test_every_emittable_geometry_matches_oracle():
+    """ANY (mask_bits, min, max) the controller can request produces cuts
+    bit-identical to native.cdc_chunk through BOTH re-expressions — the
+    XLA scan (gear.cdc_chunk_jax) and the fused Pallas kernel (interpret
+    mode) — or overflows into the declared fallback.  A retune can never
+    steer the write path onto an unverified geometry."""
+    rng = np.random.default_rng(15)
+    a = rng.integers(0, 256, 160_000, dtype=np.uint8)
+    a[:40_000] = rng.integers(97, 123, size=40_000, dtype=np.uint8)
+    ctl = AdaptiveChunkController()
+    for mb, mn, mx in ctl.emit_range():
+        mask = gear_mask(CdcConfig(mask_bits=mb, min_chunk=mn,
+                                   max_chunk=mx))
+        want = np.asarray(native.cdc_chunk(a.tobytes(), mask, mn, mx),
+                          dtype=np.uint64)
+        np.testing.assert_array_equal(
+            gear.cdc_chunk_jax(a, mask, mn, mx).astype(np.uint64), want,
+            err_msg=f"xla scan diverges at mask_bits={mb}")
+        cuts, overflowed = cdc_pallas.chunks_fused(
+            a, mask, mn, mx, mask_bits=mb, interpret=True, skip_ahead=True)
+        if overflowed:
+            continue      # the declared oracle-fallback path takes over
+        np.testing.assert_array_equal(
+            cuts, want, err_msg=f"fused kernel diverges at mask_bits={mb}")
+
+
+# ------------------------------------------------- live-reconfig validation
+
+
+class TestCdcReconfigure:
+    def test_bounds_min_max_and_routing(self):
+        from hdrf_tpu.testing.minicluster import MiniCluster
+
+        with MiniCluster(n_datanodes=1, replication=1) as mc:
+            dn = mc.datanodes[0]
+            for key in ("cdc_mask_bits", "cdc_min_chunk", "cdc_max_chunk"):
+                assert key in dn.RECONFIGURABLE
+            r = dn.reconfigure("cdc_mask_bits", 25)      # outside [6, 20]
+            assert not r["ok"] and "outside" in r["error"]
+            r = dn.reconfigure("cdc_mask_bits", "junk")
+            assert not r["ok"]
+            # min > live max refuses and names the fix
+            r = dn.reconfigure("cdc_min_chunk", 1 << 20)
+            assert not r["ok"] and "reorder" in r["error"]
+            # a valid change lands on the SHARED CdcConfig the write
+            # pipeline resolves its reducer from
+            cdc = dn.reduction_ctx.config.cdc
+            r = dn.reconfigure("cdc_max_chunk", 1 << 17)
+            assert r["ok"] and r["old"] == 65536
+            assert cdc.max_chunk == 1 << 17
+            assert dn.reduction_ctx.config.cdc is cdc
+
+
+# --------------------------------------------------------- end-to-end loop
+
+
+def test_adaptive_retune_end_to_end_and_old_reads_survive():
+    """The acceptance scenario: a dedup-poor corpus drives the controller
+    to a coarser mask through the DataNode's live-reconfig path, and data
+    committed under the OLD geometry still reads back bit-identical."""
+    import time
+
+    from hdrf_tpu.testing.minicluster import MiniCluster
+
+    overrides = {"cdc_adaptive": True, "cdc_target_mask_bits": 13}
+    with MiniCluster(n_datanodes=1, replication=1,
+                     reduction_overrides=overrides) as mc:
+        dn = mc.datanodes[0]
+        ctl = dn._cdc_controller
+        assert ctl is not None
+        # park the heartbeat loop's tick so exactly ONE deterministic
+        # observation decides (the loop fires every 0.2s here and would
+        # otherwise consume the window mid-write)
+        dn._cdc_controller = None
+        ctl.observe(*accounting.dedup_counters(), 13)   # absorb baseline
+        ctl._win_hit = ctl._win_miss = 0
+        ctl.window_chunks = 64
+        cdc = dn.reduction_ctx.config.cdc
+        mb0 = cdc.mask_bits
+        rng = np.random.default_rng(42)
+        old_data = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+        _, miss0 = accounting.dedup_counters()
+        retunes0 = int(accounting.snapshot()["counters"]
+                       .get("cdc_retunes", 0))
+        with mc.client("adaptive") as c:
+            c.write("/adaptive/old-geometry", old_data, scheme="dedup_lz4")
+            # the commit stage may be asynchronous: wait until the all-miss
+            # chunk commits are on the counters before ticking
+            deadline = time.monotonic() + 10
+            while (accounting.dedup_counters()[1] - miss0
+                   < ctl.window_chunks and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert accounting.dedup_counters()[1] - miss0 \
+                >= ctl.window_chunks
+            # >= 64 all-miss chunk commits accumulated: one heartbeat tick
+            # must coarsen by one bit through reconfigure()
+            dn._cdc_controller = ctl
+            dn._cdc_tick()
+            dn._cdc_controller = None
+            assert cdc.mask_bits == min(mb0 + 1, ctl.MASK_BITS_MAX)
+            assert cdc.min_chunk == ctl.geometry(cdc.mask_bits)[0]
+            assert cdc.max_chunk == ctl.geometry(cdc.mask_bits)[1]
+            assert cdc.min_chunk <= cdc.max_chunk
+            retunes = int(accounting.snapshot()["counters"]
+                          .get("cdc_retunes", 0))
+            assert retunes >= retunes0 + 3      # max, min, mask_bits steps
+            # new writes commit under the NEW geometry...
+            new_data = rng.integers(0, 256, 1 << 19, dtype=np.uint8)\
+                .tobytes()
+            c.write("/adaptive/new-geometry", new_data, scheme="dedup_lz4")
+            # ...and both generations read back bit-identical: fingerprints
+            # are content-addressed, offsets live in the chunk index, so
+            # the retune only moved where NEW cuts land
+            assert c.read("/adaptive/old-geometry") == old_data
+            assert c.read("/adaptive/new-geometry") == new_data

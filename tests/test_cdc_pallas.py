@@ -48,13 +48,20 @@ def _corpora():
     yield "sub-tile", rng.integers(0, 256, 300, dtype=np.uint8), 0x3F, 16, 128
 
 
+@pytest.mark.parametrize("skip_ahead", [True, False],
+                         ids=["skip-ahead", "pr4-walk"])
 @pytest.mark.parametrize("name,a,mask,mn,mx",
                          list(_corpora()),
                          ids=[c[0] for c in _corpora()])
-def test_device_cuts_bit_identical_to_native(name, a, mask, mn, mx):
+def test_device_cuts_bit_identical_to_native(name, a, mask, mn, mx,
+                                             skip_ahead):
+    """ISSUE 15 A/B: BOTH scan variants — the skip-ahead + sequence-select
+    kernel and the pinned PR 4 frontier walk — must reproduce the native
+    oracle's cuts on every corpus (the acceptance gate that runs before
+    any timing claim)."""
     cuts, overflowed = cdc_pallas.chunks_fused(
         a, mask, mn, mx, mask_bits=max(bin(mask).count("1"), 1),
-        interpret=True)
+        interpret=True, skip_ahead=skip_ahead)
     assert not overflowed
     np.testing.assert_array_equal(cuts, _oracle_cuts(a, mask, mn, mx))
 
@@ -145,6 +152,35 @@ def test_overflow_fallback_low_entropy_corpus():
     ops = {e["op"] for e in _events_after(t0)}
     assert "resident.cdc_fused" in ops         # the fused attempt
     assert "resident.prep_batch" in ops        # ...and the oracle fallback
+
+
+@pytest.mark.parametrize("skip_ahead", [True, False],
+                         ids=["skip-ahead", "pr4-walk"])
+def test_overflow_still_fires_at_smallest_controller_geometry(skip_ahead):
+    """ISSUE 15 overflow-header regression: the skip-ahead plan's
+    renewal-spacing cut capacity must stay TIGHT enough that the zeros
+    corpus still overflows into the XLA fallback at the coarsest geometry
+    the adaptive controller can emit (mask_bits floor, smallest min) —
+    a looser cap would silently truncate boundaries instead."""
+    from hdrf_tpu.reduction.accounting import AdaptiveChunkController
+
+    mb = AdaptiveChunkController.MASK_BITS_MIN
+    cdc = CdcConfig(mask_bits=mb, min_chunk=64, max_chunk=2048)
+    a = np.zeros(1 << 18, dtype=np.uint8)
+    plan = cdc_pallas.plan_for(a.size, gear_mask(cdc), cdc.mask_bits,
+                               cdc.min_chunk, cdc.max_chunk, 1 << 30,
+                               1 << 30, skip_ahead=skip_ahead)
+    want = _oracle_cuts(a, gear_mask(cdc), cdc.min_chunk, cdc.max_chunk)
+    assert len(want) > plan.cap          # the cap really is exceeded...
+    cuts, overflowed = cdc_pallas.chunks_fused(
+        a, gear_mask(cdc), cdc.min_chunk, cdc.max_chunk,
+        mask_bits=cdc.mask_bits, interpret=True, skip_ahead=skip_ahead)
+    assert overflowed                    # ...and the header reports it
+    # the skip-ahead cap is never LOOSER than the PR 4 cap
+    walk = cdc_pallas.plan_for(a.size, gear_mask(cdc), cdc.mask_bits,
+                               cdc.min_chunk, cdc.max_chunk, 1 << 30,
+                               1 << 30, skip_ahead=False)
+    assert plan.cap <= walk.cap
 
 
 def test_ledger_zero_candidate_d2h_and_one_fewer_boundary():

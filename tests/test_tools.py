@@ -202,6 +202,23 @@ class TestParityCitations:
             problems = check_parity.check_bench_contract(root, key=key)
             assert not problems, "\n".join(problems)
 
+    def test_bench_cdc_adaptive_block_in_both_json_branches(self):
+        """Adaptive-chunking bench contract (ISSUE 15): the "cdc_adaptive"
+        block — skip_ahead / scan_slab_survivors / mask_bits_effective /
+        retunes from _cdc_adaptive_summary — must be a literal key in
+        BOTH json.dumps branches of bench.py, and the summary keys must
+        be literal keys of the helper's return dict."""
+        import hdrf_tpu
+        from hdrf_tpu.tools import check_parity
+
+        root = os.path.dirname(os.path.abspath(hdrf_tpu.__file__))
+        for key in ("cdc_adaptive", "cdc_adaptive.skip_ahead",
+                    "cdc_adaptive.scan_slab_survivors",
+                    "cdc_adaptive.mask_bits_effective",
+                    "cdc_adaptive.retunes"):
+            problems = check_parity.check_bench_contract(root, key=key)
+            assert not problems, "\n".join(problems)
+
 
 class TestOfflineViewers:
     def test_oiv_oev(self, cluster, tmp_path):
