@@ -345,6 +345,70 @@ def _ec_summary() -> dict:
     }
 
 
+def _coded_exchange_summary() -> dict:
+    """Coded-exchange stamp for the JSON line: a small in-process
+    partial-sum repair through ops/rs.py — encode one container at
+    RS(6,3), rebuild a lost data stripe by XOR-folding per-holder
+    ``partial_sums`` contributions, assert bit-identity against the
+    full-gather ``reconstruct_container`` oracle — booked through the
+    SAME ``book_repair_wire`` ledger the live repair path stamps
+    (server/coded_exchange.py), so ``repair_wire_ratio`` here is the
+    process-wide gauge (this exercise plus any product repair activity:
+    a full-gather fallback in the run pulls it back up toward k).  A
+    pack/unpack round trip of a compressible payload exercises the
+    smaller-of LZ4 negotiation; pack_saved_frac is bytes saved across
+    every negotiation this process ran."""
+    from hdrf_tpu.ops import rs
+    from hdrf_tpu.server import coded_exchange
+    from hdrf_tpu.storage import stripe_store
+    from hdrf_tpu.utils import metrics
+
+    k, m = 6, 3
+    rng = np.random.default_rng(23)
+    payload = rng.integers(0, 256, size=(1 << 20) + 5,
+                           dtype=np.uint8).tobytes()
+    stripes, manifest = stripe_store.encode_container(payload, k, m)
+    stripe_len = int(manifest["stripe_len"])
+    missing = [0]
+    shards = {i: np.frombuffer(s, dtype=np.uint8)
+              for i, s in enumerate(stripes) if i not in missing}
+    have = sorted(shards)[:k]
+    rows = rs.repair_rows(k, m, tuple(have), tuple(missing))
+    col = {s: j for j, s in enumerate(have)}
+    holders = [have[0::3], have[1::3], have[2::3]]  # 3 simulated DNs
+    parts = [rs.partial_sums(np.stack([shards[s] for s in g]),
+                             rows[:, [col[s] for s in g]])
+             for g in holders if g]
+    fold = rs.xor_fold(parts)
+    oracle = stripe_store.reconstruct_container(
+        {i: s for i, s in enumerate(stripes) if i not in missing},
+        manifest, want=missing)
+    assert fold[0].tobytes() == oracle[0], \
+        "coded partial-sum repair diverged from the full-gather oracle"
+    # owner ingress: one (|missing|, stripe_len) fold from the remote
+    # chain (2 of the 3 simulated holders are remote)
+    coded_exchange.book_repair_wire(len(missing) * stripe_len,
+                                    len(missing) * stripe_len)
+    blob, enc = coded_exchange.pack(b"coded exchange negotiation " * 512)
+    assert coded_exchange.unpack(
+        blob, enc, 27 * 512) == b"coded exchange negotiation " * 512
+    ec = metrics.registry("ec")
+    ce = metrics.registry("coded_exchange")
+    raw = ce.counter("pack_raw_bytes")
+    with ec._lock:
+        ratio = ec._gauges.get("repair_wire_ratio", 0.0)
+    return {
+        "repair_wire_ratio": round(float(ratio), 4),
+        "repair_wire_bytes": ec.counter("repair_wire_bytes"),
+        "repair_rebuilt_bytes": ec.counter("repair_rebuilt_bytes"),
+        "coded_repairs": ec.counter("coded_repairs"),
+        "coded_repair_fallbacks": ec.counter("coded_repair_fallbacks"),
+        "packed_intermediates": ce.counter("packed_intermediates"),
+        "pack_saved_frac": round(
+            ce.counter("pack_saved_bytes") / raw, 4) if raw else 0.0,
+    }
+
+
 def _mirror_summary() -> dict:
     """Coded-mirror-plane stamp for the JSON line: a small in-process
     k-of-n exercise through server/mirror_plane.py's segment codec —
@@ -731,6 +795,7 @@ def main() -> None:
                 "resilience": _resilience_summary(),
                 "ec": _ec_summary(),
                 "mirror": _mirror_summary(),
+                "coded_exchange": _coded_exchange_summary(),
                 "read": _read_summary(tmp),
                 "scrub": _scrub_summary(tmp),
                 "qos": _qos_summary(),
@@ -1063,6 +1128,7 @@ def main() -> None:
             "resilience": _resilience_summary(),
             "ec": _ec_summary(),
             "mirror": _mirror_summary(),
+            "coded_exchange": _coded_exchange_summary(),
             "read": _read_summary(tmp),
             "scrub": _scrub_summary(tmp),
             "qos": _qos_summary(),

@@ -186,6 +186,137 @@ def bench_dfs(args) -> None:
                                   "stalls": led.get("stall_total", 0)}))
 
 
+def bench_ec_repair_ab(args) -> None:
+    """Paired repair A/B (ISSUE 16): classic full-gather decode vs the
+    coded partial-sum exchange, over the same container, erasure pattern,
+    and holder layout.  BEFORE timing, the coded fold is pinned
+    bit-identical to the full-gather oracle
+    (storage/stripe_store.py ``reconstruct_container``) on EVERY erasure
+    pattern up to ``m`` losses — the acceptance bar is correctness first,
+    wire ratio second.  The wire ledger mirrors the live path's
+    accounting (server/coded_exchange.py ``book_repair_wire``): full
+    gather ships k whole stripes to the repairing owner, the coded chain
+    ships one (|missing|, stripe_len) fold, holder-local contributions
+    are free, and the contributions additionally ride the smaller-of LZ4
+    negotiation.  Slope method for the timings; prints exactly ONE JSON
+    line."""
+    import itertools
+
+    import jax
+
+    from hdrf_tpu.ops import rs
+    from hdrf_tpu.server import coded_exchange
+    from hdrf_tpu.storage import stripe_store
+
+    k, m, _cell = rs.parse_policy(args.policy)
+    rng = np.random.default_rng(7)
+    n = args.mb << 20
+    # half-compressible corpus: random tiles interleaved with repeated
+    # text, the shape raw-codec container stripes actually have (sealed
+    # lz4 containers stripe to incompressible bytes and ship raw — the
+    # negotiation's enc flags report which regime this run measured)
+    tile = rng.integers(0, 256, size=max(n // 2, 1), dtype=np.uint8)
+    text = np.frombuffer(
+        (b"the quick brown fox jumps over the lazy dog. " * 8192)
+        [: max(n - tile.size, 1)], dtype=np.uint8)
+    payload = np.concatenate([tile, text])[:n].tobytes()
+    stripes, manifest = stripe_store.encode_container(payload, k, m)
+    stripe_len = int(manifest["stripe_len"])
+    arrs = {i: np.frombuffer(s, dtype=np.uint8)
+            for i, s in enumerate(stripes)}
+    dns = max(int(args.dns), 2)
+    holder_of = {i: i % dns for i in range(k + m)}  # round-robin layout
+
+    def coded_fold(missing: list[int], shards: dict[int, np.ndarray]):
+        """The owner's view of one coded repair: per-holder partial sums
+        (one bit-matmul each), XOR fold, plus the remote wire bytes."""
+        have = sorted(shards)[:k]
+        rows = rs.repair_rows(k, m, tuple(have), tuple(missing))
+        col = {s: j for j, s in enumerate(have)}
+        parts, remote = [], 0
+        for h in range(dns):
+            mine = [s for s in have if holder_of[s] == h]
+            if not mine:
+                continue
+            st = np.stack([shards[s] for s in mine])
+            parts.append(rs.partial_sums(
+                st, rows[:, [col[s] for s in mine]]))
+            if h != 0:  # holder 0 is the repairing owner: local = free
+                remote = len(missing) * stripe_len  # ONE chained fold
+        return rs.xor_fold(parts), remote
+
+    # ---- oracle pin: every erasure pattern up to m losses, small corpus
+    small, sman = stripe_store.encode_container(payload[: k * 256], k, m)
+    sarrs = {i: np.frombuffer(s, dtype=np.uint8)
+             for i, s in enumerate(small)}
+    patterns = [list(c) for e in range(1, m + 1)
+                for c in itertools.combinations(range(k + m), e)]
+    oracle_ok = True
+    for missing in patterns:
+        shards = {i: a for i, a in sarrs.items() if i not in missing}
+        want = stripe_store.reconstruct_container(
+            dict(shards), sman, want=missing)
+        fold, _ = coded_fold(missing, shards)
+        for i, w in enumerate(missing):
+            if fold[i].tobytes() != want[w]:
+                oracle_ok = False
+
+    # ---- paired timing on the full corpus; default is the common
+    # single-loss repair (full gather pays k stripes of wire per ONE
+    # rebuilt — the ratio the coded path collapses to ~1)
+    e = max(1, min(int(args.erasures), m))
+    missing = list(range(e))  # data stripes lost: decode-heavy for A
+    survivors = {i: arrs[i] for i in range(k + m) if i not in missing}
+    rebuilt = len(missing) * stripe_len
+
+    def run_full():
+        return stripe_store.reconstruct_container(
+            dict(survivors), manifest, want=missing)
+
+    def run_coded():
+        return coded_fold(missing, survivors)
+
+    def slope_mbps(fn) -> float:
+        fn()  # warm: jit compile + page in
+        t0 = time.perf_counter()
+        fn()
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(args.inner):
+            fn()
+        tk = time.perf_counter() - t0
+        per = ((tk - t1) / (args.inner - 1)) if args.inner > 1 else t1
+        return rebuilt / max(per, 1e-9) / 2**20
+
+    full_mbps = slope_mbps(run_full)
+    coded_mbps = slope_mbps(run_coded)
+
+    # ---- wire ledger (the live path's accounting, stamped in-registry)
+    fold, remote_wire = run_coded()
+    packed = coded_exchange.pack_many(
+        [fold[i].tobytes() for i in range(len(missing))])
+    coded_wire_packed = sum(len(p) for p, _ in packed)
+    full_wire = sum(len(survivors[i]) for i in sorted(survivors)[:k])
+    coded_exchange.book_repair_wire(remote_wire, rebuilt)
+    print(json.dumps({
+        "op": f"ec repair A/B [{args.policy}, slope]",
+        "mb": args.mb, "backend": jax.default_backend(),
+        "k": k, "m": m, "dns": dns, "inner": args.inner,
+        "erasures": len(missing),
+        "patterns_pinned": len(patterns),
+        "parity_oracle_ok": bool(oracle_ok),
+        "full_gather_MBps": round(full_mbps, 1),
+        "coded_repair_MBps": round(coded_mbps, 1),
+        "speedup": (round(coded_mbps / full_mbps, 3)
+                    if full_mbps > 0 else None),
+        "repair_wire_ratio_full": round(full_wire / rebuilt, 3),
+        "repair_wire_ratio_coded": round(remote_wire / rebuilt, 3),
+        "repair_wire_ratio_coded_lz4": round(
+            coded_wire_packed / rebuilt, 3),
+        "wire_saved_frac": round(1 - remote_wire / full_wire, 4),
+    }))
+
+
 def bench_ec(args) -> None:
     """EC cold-tier harness: paired encode / intact-reassembly /
     degraded-decode slopes over the container striping path
@@ -199,6 +330,8 @@ def bench_ec(args) -> None:
     tier's read penalty.  Parity is pinned against the GF log/antilog
     oracle (rs.encode_ref) before timing.  Prints exactly ONE JSON
     line."""
+    if getattr(args, "repair_ab", False):
+        return bench_ec_repair_ab(args)
     import jax
 
     from hdrf_tpu.ops import rs
@@ -829,6 +962,14 @@ def main(argv: list[str] | None = None) -> int:
     d.add_argument("--policy", default="rs-6-3-64k")
     d.add_argument("--inner", type=int, default=4,
                    help="k for the slope method's long pass")
+    d.add_argument("--repair-ab", action="store_true",
+                   help="paired repair A/B: full-gather decode vs coded "
+                        "partial-sum exchange, oracle-pinned on every "
+                        "erasure pattern; one JSON line")
+    d.add_argument("--dns", type=int, default=5,
+                   help="simulated holder count for --repair-ab")
+    d.add_argument("--erasures", type=int, default=1,
+                   help="stripes lost in the --repair-ab timed pattern")
     d.set_defaults(fn=bench_ec)
     d = sub.add_parser("reduction")
     d.add_argument("--mb", type=int, default=64)

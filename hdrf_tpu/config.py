@@ -191,6 +191,26 @@ class ReductionConfig:
     # to land, so one straggling holder never sets read latency.
     # 0 restores the serial holder-by-holder gather.
     ec_read_hedge_delta: int = 1
+    # Coded-exchange shuffle plane (server/coded_exchange.py).
+    # ec_coded_repair: stripe repair gathers partial SUMS instead of full
+    # stripes — each surviving holder bit-matmuls its local stripes into a
+    # GF-combined contribution and the chain XOR-folds them on the way back,
+    # so the repairing owner ingests ~|missing| stripes of bytes instead of
+    # k (ops/rs.py repair_rows/partial_sums).  False pins the classic full
+    # gather (byte-identical output either way — the partial-sum fold IS
+    # the decode, redistributed).
+    ec_coded_repair: bool = True
+    # LZ4-compress coded-exchange intermediates (repair contributions,
+    # stripe pushes on demote/repair) via the batched compress path
+    # (ops/dispatch.py block_compress_batch; on-TPU compress_many when the
+    # backend resolves to tpu).  Negotiated per op: smaller-of ships, raw
+    # wins ties, old peers that never asked get raw — False pins raw.
+    coded_exchange_compress: bool = True
+    # Mirror-plane segment legs (server/mirror_plane.py) ship
+    # LZ4-compressed segments under the same smaller-of negotiation
+    # (seg_crc always covers the RAW bytes).  False pins the old raw
+    # path for A/B.
+    mirror_compress_segments: bool = True
     # Content-adaptive chunk sizing (reduction/accounting.py
     # AdaptiveChunkController): the DN heartbeat observes the dedup
     # hit/miss counters and retunes cdc_mask_bits/min/max through the

@@ -18,6 +18,17 @@ HBM-bandwidth-bound — the right regime for an erasure code.
 
 Host oracle: `gf_mul`/`encode_ref` implement the same code in numpy GF(2^8)
 log/antilog arithmetic; kernels are asserted bit-identical in tests.
+
+Partial-sum repair (the coded-exchange plane, server/coded_exchange.py):
+because decode is linear — missing[w] = XOR_j coeff[w,j] * survivor[j] with
+coeff = `repair_rows` — each holder can apply ITS columns to ITS stripes
+locally (`partial_sums`, one bit-matmul) and ship only the (|want|, L)
+contribution; XOR-folding the per-holder contributions reproduces
+`rs_decode` bit-identically (GF(2^8) addition IS xor).  This is the
+partial-parallel-repair / repair-pipelining shape of the coded-computing
+line (arXiv 1802.03049, arXiv 1805.01993), re-expressed over the same
+Cauchy bit-matmul as encode; `partial_sums_ref` is the log/antilog oracle
+(re-derives DFSStripedOutputStream.java:81's decode split across holders).
 """
 
 from __future__ import annotations
@@ -188,6 +199,35 @@ def rs_encode(data: bytes | np.ndarray, k: int, m: int) -> np.ndarray:
     return np.asarray(out)
 
 
+@functools.cache
+def repair_rows(k: int, m: int, have: tuple[int, ...],
+                want: tuple[int, ...]) -> np.ndarray:
+    """GF(256) repair matrix R, u8[len(want), k]:
+    ``missing[w] = XOR_j gf_mul(R[w, j], survivor[have[j]])``.
+
+    ``have`` names the k survivor indices in use (sorted), ``want`` the
+    indices to rebuild (data or parity).  The decode seam shared by
+    rs_decode (full gather) and the partial-sum repair plane: each
+    holder's contribution applies the COLUMNS of R matching its local
+    survivors, so the per-holder split is just column selection."""
+    if len(have) != k:
+        raise ValueError(f"need {k} survivor indices, got {len(have)}")
+    g = rs_matrix(k, m)
+    sub = g[list(have)]                 # (k, k) rows that produced survivors
+    inv = gf_mat_inv(sub)               # data = inv @ survivors
+    rows = np.zeros((len(want), k), dtype=np.uint8)
+    for r, idx in enumerate(want):
+        if idx < k:
+            rows[r] = inv[idx]
+        else:  # parity shard: re-encode from decoded data = g[idx] @ inv
+            for j in range(k):
+                acc = 0
+                for t in range(k):
+                    acc ^= gf_mul(int(g[idx, t]), int(inv[t, j]))
+                rows[r, j] = acc
+    return rows
+
+
 def rs_decode(shards: dict[int, np.ndarray], k: int, m: int,
               want: list[int] | None = None) -> dict[int, np.ndarray]:
     """Recover missing shards from any k survivors.
@@ -196,7 +236,6 @@ def rs_decode(shards: dict[int, np.ndarray], k: int, m: int,
     k..k+m-1 = parity).  Returns {index: u8[L]} for ``want`` (default: the
     missing data shards).
     """
-    g = rs_matrix(k, m)
     have = sorted(shards)[:k]
     if len(have) < k:
         raise ValueError(f"need {k} shards, have {len(have)}")
@@ -204,23 +243,53 @@ def rs_decode(shards: dict[int, np.ndarray], k: int, m: int,
         want = [i for i in range(k) if i not in shards]
     if not want:
         return {}
-    sub = g[have]                       # (k, k) rows that produced survivors
-    inv = gf_mat_inv(sub)               # data = inv @ survivors
-    rows = []
-    for idx in want:
-        if idx < k:
-            rows.append(inv[idx])
-        else:  # parity shard: re-encode from decoded data = g[idx] @ inv
-            exp, log = _tables()
-            row = np.zeros(k, dtype=np.uint8)
-            for j in range(k):
-                acc = 0
-                for t in range(k):
-                    acc ^= gf_mul(int(g[idx, t]), int(inv[t, j]))
-                row[j] = acc
-            rows.append(row)
-    mat = _bit_matrix(np.stack(rows))
+    rows = repair_rows(k, m, tuple(have), tuple(want))
+    mat = _bit_matrix(rows)
     surv = np.stack([shards[i] for i in have])
     out = _bit_matmul(jnp.asarray(mat), jax.device_put(surv), len(want))
     out = np.asarray(out)
     return {idx: out[i] for i, idx in enumerate(want)}
+
+
+def partial_sums(stripes: np.ndarray, coeffs: np.ndarray) -> np.ndarray:
+    """One holder's repair contribution: u8[nwant, L] from its LOCAL
+    survivor stripes u8[n, L] and its repair_rows column slice
+    u8[nwant, n] — a single Cauchy bit-matmul on the accelerator, the
+    same kernel encode uses.  XOR-folding every holder's output equals
+    ``rs_decode`` of the full gather bit-for-bit."""
+    coeffs = np.asarray(coeffs, dtype=np.uint8)
+    nwant = coeffs.shape[0]
+    out = _bit_matmul(jnp.asarray(_bit_matrix(coeffs)),
+                      jax.device_put(np.asarray(stripes)), nwant)
+    return np.asarray(out)
+
+
+def partial_sums_ref(stripes: np.ndarray, coeffs: np.ndarray) -> np.ndarray:
+    """Host oracle for ``partial_sums``: GF log/antilog table arithmetic
+    (the same tables encode_ref pins against)."""
+    stripes = np.asarray(stripes, dtype=np.uint8)
+    coeffs = np.asarray(coeffs, dtype=np.uint8)
+    nwant, n = coeffs.shape
+    L = stripes.shape[1]
+    exp, log = _tables()
+    out = np.zeros((nwant, L), dtype=np.uint8)
+    for w in range(nwant):
+        acc = np.zeros(L, dtype=np.uint8)
+        for j in range(n):
+            c = int(coeffs[w, j])
+            if c:
+                nz = stripes[j] != 0
+                prod = np.zeros(L, dtype=np.uint8)
+                prod[nz] = exp[log[c] + log[stripes[j][nz]]]
+                acc ^= prod
+        out[w] = acc
+    return out
+
+
+def xor_fold(parts: list[np.ndarray]) -> np.ndarray:
+    """Accumulate per-holder contributions: GF(2^8) addition is XOR, so
+    the fold is associative/commutative — chain order never matters."""
+    acc = np.array(parts[0], dtype=np.uint8, copy=True)
+    for p in parts[1:]:
+        acc ^= np.asarray(p, dtype=np.uint8)
+    return acc

@@ -61,6 +61,16 @@ READ_BLOCK = "read_block"
 TRANSFER_BLOCK = "transfer_block"
 COPY_BLOCK = "copy_block"
 BLOCK_CHECKSUM = "block_checksum"
+# EC cold-tier stripe ops (server/ec_tier.py; DN-protocol trust — stripe
+# ops never carry client bytes).  STRIPE_CODED_READ is the coded-exchange
+# sibling of STRIPE_READ: the request carries a per-DN chain plan plus
+# negotiation fields (``accept_enc`` — may the response ship LZ4'd payloads
+# with per-item ``enc`` flags?), so a peer that predates the op simply
+# books unknown_ops and answers nothing — the caller's recv fails and it
+# falls back to plain STRIPE_READ legs, byte-identical results either way.
+STRIPE_READ = "stripe_read"
+STRIPE_WRITE = "stripe_write"
+STRIPE_CODED_READ = "stripe_coded_read"
 
 
 def secure_socket(sock: socket.socket, token: dict | None, encrypt: bool):

@@ -267,6 +267,13 @@ class DataNode:
         from hdrf_tpu.server.ec_tier import EcTier
 
         self.ec = EcTier(self)
+        # Coded-exchange plane (server/coded_exchange.py): the shared
+        # background bulk-transfer sender — QoS control lane + balance
+        # throttle + smaller-of LZ4 negotiation — used by EC repair/demote
+        # legs and any future rebalance/compaction move.
+        from hdrf_tpu.server.coded_exchange import CodedExchange
+
+        self.coded = CodedExchange(self)
         # Multi-block write pipeline (server/write_pipeline.py): shared
         # device batches + overlap scheduling when depth > 1; None keeps
         # the one-block-at-a-time serial path exactly as before.
@@ -792,13 +799,17 @@ class DataNode:
                 fields["block_id"], fields["length"],
                 new_gs=fields.get("new_gen_stamp"))
             send_frame(sock, {"ok": ok})
-        elif op == "stripe_read":
+        elif op == dt.STRIPE_READ:
             # EC cold tier: hand one local stripe to a gathering peer
             # (DN-protocol trust, like disk_balance — stripe ops never
             # carry client bytes, only already-stored container stripes)
             self.ec.serve_read(sock, fields)
-        elif op == "stripe_write":
+        elif op == dt.STRIPE_WRITE:
             self.ec.serve_write(sock, fields)
+        elif op == dt.STRIPE_CODED_READ:
+            # coded-exchange partial-sum repair hop (server/ec_tier.py
+            # serve_coded_read; same DN-protocol trust as stripe_read)
+            self.ec.serve_coded_read(sock, fields)
         else:
             _M.incr("unknown_ops")
 

@@ -33,7 +33,18 @@ roles live here:
   tier.
 - **Repair** (NN ``stripe_repair`` command): re-decode exactly the lost
   stripe indices from k survivors and push them to replacement holders,
-  keeping the manifest's holder map current.
+  keeping the manifest's holder map current.  With ``ec_coded_repair``
+  the gather runs as a partial-sum coded exchange
+  (server/coded_exchange.py; ops/rs.py ``partial_sums``): one
+  ``stripe_coded_read`` chained through the remote holders, each
+  bit-matmuling its LOCAL stripes into a (|missing|, stripe_len)
+  contribution and XOR-folding it into the response riding back — the
+  repairing owner ingests ~|missing| stripes of bytes instead of k,
+  CRC-verifies every rebuilt stripe against the manifest, and falls back
+  to the classic full gather (which CRC-filters corrupt stripes as
+  erasures) on any mismatch, old peer, or chain failure.  Repair and
+  demote both run on the QoS control lane (utils/qos.py ``background()``)
+  so background reconstruction can never shed a foreground tenant.
 """
 
 from __future__ import annotations
@@ -41,10 +52,17 @@ from __future__ import annotations
 import os
 import time
 
+import numpy as np
+
+from hdrf_tpu import native
+from hdrf_tpu.ops import rs
+from hdrf_tpu.proto import datatransfer as dt
 from hdrf_tpu.reduction import accounting
+from hdrf_tpu.server import coded_exchange
 from hdrf_tpu.storage import stripe_store
 from hdrf_tpu.storage.container_store import _SEAL_HDR, _SEAL_MAGIC
-from hdrf_tpu.utils import fault_injection, metrics, profiler, retry, rollwin
+from hdrf_tpu.utils import (fault_injection, metrics, profiler, qos, retry,
+                            rollwin)
 
 _M = metrics.registry("ec")
 
@@ -100,6 +118,15 @@ class EcTier:
         _M.incr("stripe_gathers")
         got = self._gather(cid, manifest)
         k = int(manifest["k"])
+        bad = {i for i, v in got.items()
+               if int(native.crc32c(v)) != int(manifest["crcs"][i])}
+        if bad:
+            # a corrupt survivor is an erasure, not an input: re-gather
+            # around it so the decode still sees k intact stripes
+            _M.incr("repair_corrupt_survivors", len(bad))
+            more = self._gather(cid, manifest, exclude=set(got))
+            got = {i: v for i, v in got.items() if i not in bad}
+            got.update(more)
         if len(got) < k:
             _M.incr("degraded_read_failures")
             return None
@@ -116,7 +143,11 @@ class EcTier:
     # ---------------------------------------------------------- serving
 
     def serve_read(self, sock, fields: dict) -> None:
-        """Peer ``stripe_read``: hand one local stripe to a gatherer."""
+        """Peer ``stripe_read``: hand one local stripe to a gatherer.
+        A gatherer that sent ``accept_enc=1`` may get an LZ4 payload back
+        (``enc=1`` + ``usize``) under coded_exchange's smaller-of
+        negotiation; callers that never ask — old peers — always get raw
+        bytes, so mixed versions stay byte-identical."""
         from hdrf_tpu.proto.rpc import send_frame
 
         fault_injection.point("stripe.read", dn_id=self._dn.dn_id)
@@ -128,21 +159,105 @@ class EcTier:
             send_frame(sock, {"ok": False,
                               "error": f"no stripe {owner}/{cid}/{idx}"})
             return
-        send_frame(sock, {"ok": True, "data": data})
+        usize = len(data)
+        enc = 0
+        if int(fields.get("accept_enc", 0)) and self._dn.coded.compress_on:
+            data, enc = coded_exchange.pack(data, self._dn.coded.backend)
+        send_frame(sock, {"ok": True, "data": data, "enc": enc,
+                          "usize": usize})
 
     def serve_write(self, sock, fields: dict) -> None:
         """Peer ``stripe_write``: durably store a stripe pushed by the
-        demoting/repairing owner (CRC-checked before the ack)."""
+        demoting/repairing owner (CRC-checked before the ack).  ``enc=1``
+        payloads are LZ4'd by the pusher's coded-exchange negotiation and
+        decode to ``usize`` raw bytes BEFORE the CRC check, so the stored
+        file and its CRC are identical to the raw path's."""
         from hdrf_tpu.proto.rpc import send_frame
 
         try:
+            data = fields["data"]
+            if int(fields.get("enc", 0)):
+                data = coded_exchange.unpack(data, 1, int(fields["usize"]))
             self.store.put_stripe(fields["owner"], int(fields["cid"]),
-                                  int(fields["idx"]), fields["data"],
+                                  int(fields["idx"]), data,
                                   crc=fields.get("crc"))
-        except stripe_store.StripeCorrupt as e:
-            send_frame(sock, {"ok": False, "error": str(e)})
+        except (stripe_store.StripeCorrupt, ValueError, KeyError,
+                RuntimeError, OSError) as e:
+            send_frame(sock, {"ok": False,
+                              "error": f"{type(e).__name__}: {e}"})
             return
         send_frame(sock, {"ok": True})
+
+    def serve_coded_read(self, sock, fields: dict) -> None:
+        """Peer ``stripe_coded_read``: one hop of a partial-sum repair
+        chain.  Compute this DN's GF-combined contribution over its LOCAL
+        survivor stripes (ops/rs.py ``partial_sums`` — one Cauchy
+        bit-matmul, the coefficients ride in the plan), relay the rest of
+        the plan to the next holder, XOR its returned partial sums into
+        ours, and answer the fold — so the response traveling back to the
+        repairing owner always carries exactly (|missing|, stripe_len)
+        bytes no matter how many holders contributed.  Any hop failure
+        answers ok=False and the owner falls back to the full gather."""
+        from hdrf_tpu.proto.rpc import send_frame
+
+        dn = self._dn
+        fault_injection.point("stripe.coded_read", dn_id=dn.dn_id)
+        try:
+            owner = fields["owner"]
+            cid = int(fields["cid"])
+            stripe_len = int(fields["stripe_len"])
+            nwant = int(fields["nwant"])
+            accept_enc = int(fields.get("accept_enc", 0))
+            plan = [list(e) for e in fields["plan"]]
+            mine = next((e for e in plan if e[0] == dn.dn_id), None)
+            rest = [e for e in plan if e[0] != dn.dn_id]
+            with qos.background():
+                parts = np.zeros((nwant, stripe_len), dtype=np.uint8)
+                if mine is not None:
+                    coeff_map = mine[3]
+                    idxs = sorted(int(s) for s in coeff_map)
+                    stripes = np.stack([np.frombuffer(
+                        self.store.read_stripe(owner, cid, s),
+                        dtype=np.uint8) for s in idxs])
+                    coeffs = np.stack(
+                        [np.asarray(coeff_map[str(s)], dtype=np.uint8)
+                         for s in idxs], axis=1)
+                    parts ^= rs.partial_sums(stripes, coeffs)
+                if rest:
+                    nxt = rest[0]
+                    br = retry.breaker(f"{dn.dn_id}->{nxt[0]}")
+                    try:
+                        resp = dn.coded.send(
+                            (nxt[1], int(nxt[2])), dt.STRIPE_CODED_READ,
+                            nwant * stripe_len, owner=owner, cid=cid,
+                            stripe_len=stripe_len, nwant=nwant, plan=rest,
+                            accept_enc=accept_enc)
+                        if not resp.get("ok"):
+                            raise IOError(resp.get("error", "coded relay"))
+                    except (OSError, ConnectionError, IOError, KeyError):
+                        br.record_failure()
+                        raise
+                    br.record_success()
+                    encs = resp.get("enc") or [0] * len(resp["parts"])
+                    _M.incr("coded_relay_bytes",
+                            sum(len(p) for p in resp["parts"]))
+                    for i, (p, e) in enumerate(zip(resp["parts"], encs)):
+                        parts[i] ^= np.frombuffer(
+                            coded_exchange.unpack(p, e, stripe_len),
+                            dtype=np.uint8)
+                blobs = [parts[i].tobytes() for i in range(nwant)]
+                if accept_enc and dn.coded.compress_on:
+                    packed = coded_exchange.pack_many(blobs,
+                                                      dn.coded.backend)
+                else:
+                    packed = [(b, 0) for b in blobs]
+            send_frame(sock, {"ok": True,
+                              "parts": [p for p, _ in packed],
+                              "enc": [e for _, e in packed]})
+        except (OSError, ConnectionError, IOError, KeyError, ValueError,
+                RuntimeError, qos.ShedError) as e:
+            send_frame(sock, {"ok": False,
+                              "error": f"{type(e).__name__}: {e}"})
 
     # --------------------------------------------------------- demotion
 
@@ -169,7 +284,7 @@ class EcTier:
             if loc is not None and loc.container_id not in cids:
                 cids.append(loc.container_id)
         done: list[dict] = []
-        with retry.bind(retry.Deadline(_CMD_BUDGET_S)):
+        with retry.bind(retry.Deadline(_CMD_BUDGET_S)), qos.background():
             for cid in cids:
                 if dn.index.stripe_manifest(cid) is not None:
                     continue  # already striped (shared container)
@@ -224,14 +339,34 @@ class EcTier:
         owner = manifest.get("owner", dn.dn_id)
         missing = [int(i) for i in cmd["missing"]]
         targets = [list(t) for t in cmd["targets"]]
-        with retry.bind(retry.Deadline(_CMD_BUDGET_S)):
-            got = self._gather(cid, manifest, exclude=set(missing))
-            try:
-                decoded = stripe_store.reconstruct_container(
-                    got, manifest, want=missing)
-            except (stripe_store.StripeCorrupt, ValueError):
-                _M.incr("repair_failures")
-                return
+        red = dn.reduction_ctx.config
+        with retry.bind(retry.Deadline(_CMD_BUDGET_S)), qos.background():
+            decoded = None
+            if getattr(red, "ec_coded_repair", True):
+                decoded = self._gather_coded(cid, manifest, missing)
+            if decoded is None:
+                # classic full gather: k whole stripes to the owner, CRC-
+                # filtered per stripe (corrupt survivors become erasures)
+                got = self._gather(cid, manifest, exclude=set(missing))
+                bad = {i for i, v in got.items()
+                       if int(native.crc32c(v)) != int(manifest["crcs"][i])}
+                if bad:
+                    # a corrupt survivor is an erasure: re-gather around
+                    # it so the decode still sees k intact stripes
+                    _M.incr("repair_corrupt_survivors", len(bad))
+                    more = self._gather(
+                        cid, manifest, exclude=set(missing) | set(got))
+                    got = {i: v for i, v in got.items() if i not in bad}
+                    got.update(more)
+                try:
+                    decoded = stripe_store.reconstruct_container(
+                        got, manifest, want=missing)
+                except (stripe_store.StripeCorrupt, ValueError):
+                    _M.incr("repair_failures")
+                    return
+                coded_exchange.book_repair_wire(
+                    sum(len(v) for v in got.values()),
+                    sum(len(v) for v in decoded.values()))
             holders = [list(t) for t in manifest["holders"]]
             try:
                 for idx, tgt in zip(missing, targets):
@@ -276,13 +411,28 @@ class EcTier:
         if tgt_id == dn.dn_id:
             self.store.put_stripe(owner, cid, idx, data, crc=crc)
             return
-        dn.balance_throttler.throttle(len(data))
+        # coded-exchange push: smaller-of LZ4 negotiation (sealed-container
+        # stripes are usually incompressible and ship raw; raw-codec and
+        # parity-of-raw stripes compress), paced + admitted inside
+        # dn.coded.send on the background lane
+        wire, enc = data, 0
+        if dn.coded.compress_on:
+            wire, enc = coded_exchange.pack(data, dn.coded.backend)
+        _M.incr("stripe_push_raw_bytes", len(data))
+        _M.incr("stripe_push_wire_bytes", len(wire))
+        state = {"wire": wire, "enc": enc}
 
         def _push() -> None:
-            resp = dn._peer_call((host, port), "stripe_write",
-                                 owner=owner, cid=cid, idx=idx,
-                                 data=data, crc=crc)
+            resp = dn.coded.send((host, port), dt.STRIPE_WRITE,
+                                 len(state["wire"]), owner=owner, cid=cid,
+                                 idx=idx, data=state["wire"],
+                                 enc=state["enc"], usize=len(data), crc=crc)
             if not resp.get("ok"):
+                if state["enc"]:
+                    # peer refused the encoded payload (old version or
+                    # decode failure): re-negotiate to raw for the retries
+                    _M.incr("stripe_push_enc_fallbacks")
+                    state["wire"], state["enc"] = data, 0
                 raise IOError(f"stripe_write {cid}/{idx} to {tgt_id}: "
                               f"{resp.get('error')}")
         retry.call_with_retries(
@@ -332,6 +482,8 @@ class EcTier:
         primaries = usable[:k]
         hedge_idxs = usable[k:k + delta]
 
+        accept_enc = 1 if dn.coded.compress_on else 0
+
         def leg(idx: int):
             tgt_id, host, port = (holders[idx][0], holders[idx][1],
                                   int(holders[idx][2]))
@@ -347,13 +499,17 @@ class EcTier:
                     if not br.allow():
                         raise retry.BreakerOpen(f"{dn.dn_id}->{tgt_id}")
                     try:
-                        resp = dn._peer_call((host, port), "stripe_read",
-                                             owner=owner, cid=cid, idx=idx)
+                        resp = dn._peer_call((host, port), dt.STRIPE_READ,
+                                             owner=owner, cid=cid, idx=idx,
+                                             accept_enc=accept_enc)
                         if not resp.get("ok"):
                             raise IOError(
                                 resp.get("error", "stripe_read failed"))
-                        data = resp["data"]
-                    except (OSError, ConnectionError, IOError, KeyError):
+                        data = coded_exchange.unpack(
+                            resp["data"], int(resp.get("enc", 0)),
+                            int(resp.get("usize", 0)))
+                    except (OSError, ConnectionError, IOError, KeyError,
+                            ValueError):
                         br.record_failure()
                         raise
                     br.record_success()
@@ -376,9 +532,17 @@ class EcTier:
                     k=k, hedge_after_s=hedge_after,
                     timeout_s=_CMD_BUDGET_S,
                     on_hedge=lambda: _M.incr("ec_hedges_fired"))
-        except retry.QuorumFailed:
+        except retry.QuorumFailed as e:
             _M.incr("ec_hedge_fallbacks")
-            return self._gather_serial(cid, manifest, exclude)
+            # hand the serial fallback the holders that JUST failed so it
+            # does not burn its budget re-contacting them (their breakers
+            # may need more consecutive failures to open)
+            legs_by_pos = primaries + hedge_idxs
+            failed = {holders[legs_by_pos[j]][0] for j, _err in e.errors
+                      if j < len(legs_by_pos)
+                      and holders[legs_by_pos[j]][0] != dn.dn_id}
+            return self._gather_serial(cid, manifest, exclude,
+                                       failed=failed)
         got: dict[int, bytes] = {}
         for leg_i, (sidx, data) in wins:
             got[sidx] = data
@@ -388,15 +552,21 @@ class EcTier:
         return got
 
     def _gather_serial(self, cid: int, manifest: dict,
-                       exclude: set[int] | None = None) -> dict[int, bytes]:
+                       exclude: set[int] | None = None,
+                       failed: set[str] | None = None) -> dict[int, bytes]:
         """Serial fallback gather: fetch up to k stripes one holder at a
-        time, data indices first, skipping ``exclude`` and breaker-open
-        peers (the pre-hedging PR-10 path, kept for δ = 0 and for
-        quorum-miss recovery)."""
+        time, data indices first, skipping ``exclude``, holders that just
+        failed the hedged attempt (``failed``), and breaker-open peers —
+        the same probe-free ``.state`` peek the k+δ path uses, so a
+        half-open edge's single probe is spent at CALL time (br.allow()),
+        never on the skip decision.  Leg latencies feed the same
+        ``_leg_win`` windows as the hedged legs, so serial rounds keep the
+        hedge-trigger p95s warm instead of letting them age out."""
         dn = self._dn
         k, m = int(manifest["k"]), int(manifest["m"])
         owner = manifest.get("owner", dn.dn_id)
         holders = manifest["holders"]
+        accept_enc = 1 if dn.coded.compress_on else 0
         got: dict[int, bytes] = {}
         with profiler.phase("ec_gather"):
             for idx in range(k + m):
@@ -412,22 +582,138 @@ class EcTier:
                     except OSError:
                         continue
                     continue
+                if failed and tgt_id in failed:
+                    _M.incr("serial_failed_skips")
+                    continue
                 br = retry.breaker(f"{dn.dn_id}->{tgt_id}")
-                if not br.allow():
+                if br.state == "open" or not br.allow():
                     _M.incr("breaker_skips")
                     continue
+                t0 = time.monotonic()
                 try:
-                    resp = dn._peer_call((host, port), "stripe_read",
-                                         owner=owner, cid=cid, idx=idx)
+                    resp = dn._peer_call((host, port), dt.STRIPE_READ,
+                                         owner=owner, cid=cid, idx=idx,
+                                         accept_enc=accept_enc)
                     if not resp.get("ok"):
                         raise IOError(resp.get("error", "stripe_read failed"))
-                    got[idx] = resp["data"]
+                    got[idx] = coded_exchange.unpack(
+                        resp["data"], int(resp.get("enc", 0)),
+                        int(resp.get("usize", 0)))
                     br.record_success()
-                except (OSError, ConnectionError, IOError, KeyError):
+                    self._leg_win.note(tgt_id, time.monotonic() - t0)
+                except (OSError, ConnectionError, IOError, KeyError,
+                        ValueError):
                     br.record_failure()
                     continue
         accounting.record_stripe_gather(sum(len(v) for v in got.values()))
         return got
+
+    def _gather_coded(self, cid: int, manifest: dict,
+                      missing: list[int]) -> dict[int, bytes] | None:
+        """Partial-sum repair gather (ops/rs.py ``repair_rows`` /
+        ``partial_sums``; the repair-pipelining shape of arXiv
+        1802.03049): pick k breaker-closed survivors, split the repair
+        matrix's columns by holding DN, fold this DN's local
+        contribution for free, and chain ONE ``stripe_coded_read``
+        through the remote holders — each XORs its contribution into the
+        (|missing|, stripe_len) response riding back, so owner ingress is
+        ~|missing| stripes instead of k.  Every rebuilt stripe is
+        CRC-verified against the manifest: a corrupt contribution
+        anywhere in the fold surfaces there (the sum hides WHICH survivor
+        was bad), and ``None`` sends the caller to the classic gather,
+        which CRC-filters per stripe and treats the corrupt one as an
+        erasure.  ``None`` likewise on any chain/peer/old-version
+        failure — the fallback is byte-identical."""
+        dn = self._dn
+        if not missing:
+            return {}
+        k, m = int(manifest["k"]), int(manifest["m"])
+        owner = manifest.get("owner", dn.dn_id)
+        holders = manifest["holders"]
+        stripe_len = int(manifest["stripe_len"])
+        exclude = set(missing)
+        usable: list[int] = []
+        for idx in range(k + m):
+            if idx in exclude:
+                continue
+            tgt_id = holders[idx][0]
+            if (tgt_id != dn.dn_id
+                    and retry.breaker(f"{dn.dn_id}->{tgt_id}").state
+                    == "open"):
+                _M.incr("breaker_skips")
+                continue
+            usable.append(idx)
+        if len(usable) < k:
+            return None
+        have = usable[:k]
+        rows = rs.repair_rows(k, m, tuple(have), tuple(missing))
+        col_of = {s: j for j, s in enumerate(have)}
+        local: list[int] = []
+        groups: dict[str, tuple[tuple, list[int]]] = {}
+        for s in have:
+            tgt_id, host, port = (holders[s][0], holders[s][1],
+                                  int(holders[s][2]))
+            if tgt_id == dn.dn_id:
+                local.append(s)
+            else:
+                groups.setdefault(tgt_id, ((host, port), []))[1].append(s)
+        parts = np.zeros((len(missing), stripe_len), dtype=np.uint8)
+        if local:
+            try:
+                stripes = np.stack([np.frombuffer(
+                    self.store.read_stripe(owner, cid, s), dtype=np.uint8)
+                    for s in local])
+            except OSError:
+                return None
+            coeffs = rows[:, [col_of[s] for s in local]]
+            parts ^= rs.partial_sums(stripes, coeffs)
+        wire = 0
+        if groups:
+            # one chain through the remote holders; per-survivor coeff
+            # columns ride as str-keyed lists (msgpack-stable)
+            plan = [[tgt_id, addr[0], addr[1],
+                     {str(s): [int(c) for c in rows[:, col_of[s]]]
+                      for s in idxs}]
+                    for tgt_id, (addr, idxs) in groups.items()]
+            head = plan[0]
+            br = retry.breaker(f"{dn.dn_id}->{head[0]}")
+            try:
+                with profiler.phase("ec_gather"):
+                    resp = dn.coded.send(
+                        (head[1], int(head[2])), dt.STRIPE_CODED_READ,
+                        len(missing) * stripe_len, owner=owner, cid=cid,
+                        stripe_len=stripe_len, nwant=len(missing),
+                        plan=plan, accept_enc=1 if dn.coded.compress_on
+                        else 0)
+                if not resp.get("ok"):
+                    raise IOError(resp.get("error", "coded read failed"))
+                encs = resp.get("enc") or [0] * len(resp["parts"])
+                wire = sum(len(p) for p in resp["parts"])
+                for i, (p, e) in enumerate(zip(resp["parts"], encs)):
+                    parts[i] ^= np.frombuffer(
+                        coded_exchange.unpack(p, e, stripe_len),
+                        dtype=np.uint8)
+            except (OSError, ConnectionError, IOError, KeyError,
+                    ValueError, RuntimeError):
+                # unknown op on an old peer lands here too (no response
+                # frame -> recv error): classic gather takes over
+                br.record_failure()
+                _M.incr("coded_repair_fallbacks")
+                return None
+            br.record_success()
+        decoded: dict[int, bytes] = {}
+        for i, w in enumerate(missing):
+            blob = parts[i].tobytes()
+            if int(native.crc32c(blob)) != int(manifest["crcs"][w]):
+                _M.incr("coded_contrib_corrupt")
+                _M.incr("coded_repair_fallbacks")
+                return None
+            decoded[w] = blob
+        accounting.record_stripe_gather(wire)
+        coded_exchange.book_repair_wire(wire,
+                                        len(missing) * stripe_len)
+        _M.incr("coded_repairs")
+        return decoded
 
     def _notify_nn(self, block_id, containers: list[dict],
                    owner: str | None = None) -> None:

@@ -324,13 +324,31 @@ class MirrorPlane:
 
     def _send_segment(self, target: dict, idx: int, seg: bytes,
                       common: dict) -> None:
+        """One segment leg.  With ``mirror_compress_segments`` the wire
+        payload rides coded_exchange's smaller-of LZ4 negotiation
+        (``seg_enc``/``seg_usize``; ``seg_crc`` always covers the RAW
+        segment, so the stored bytes and their check are knob-invariant —
+        the knob pins the old raw path for A/B)."""
+        from hdrf_tpu.server import coded_exchange
+
         dn = self._dn
+        red = dn.config.reduction
+        wire, extra = seg, {}
+        if getattr(red, "mirror_compress_segments", True):
+            payload, enc = coded_exchange.pack(
+                seg, coded_exchange.backend_for(red))
+            if enc:
+                wire = payload
+                extra = {"seg_enc": 1, "seg_usize": len(seg)}
+                _M.incr("segments_compressed")
+        _M.incr("segment_raw_bytes", len(seg))
+        _M.incr("segment_wire_bytes", len(wire))
         sock = _connect(target["addr"], dn, common["block_id"])
         try:
             dt.send_op(sock, "mirror_segment", **common, seg_index=idx,
-                       seg_crc=int(native.crc32c(seg)),
+                       seg_crc=int(native.crc32c(seg)), **extra,
                        token=dn.tokens.mint(common["block_id"], "w"),
-                       data=seg)
+                       data=wire)
             resp = recv_frame(sock)
             if not resp.get("ok"):
                 raise IOError(f"segment leg refused: "
@@ -349,6 +367,11 @@ class MirrorPlane:
             fault_injection.point("mirror_plane.segment", dn_id=dn.dn_id,
                                   block_id=block_id, seg_index=idx)
             data = bytes(fields["data"])
+            if int(fields.get("seg_enc", 0)):
+                from hdrf_tpu.server import coded_exchange
+
+                data = coded_exchange.unpack(data, 1,
+                                             int(fields["seg_usize"]))
             if int(native.crc32c(data)) != fields["seg_crc"]:
                 raise IOError(f"segment {idx} of block {block_id} "
                               f"failed CRC")
@@ -361,7 +384,7 @@ class MirrorPlane:
             dn.notify_block_received(block_id, fields["logical_len"],
                                      fields["gen_stamp"], partial=True)
             send_frame(sock, {"ok": True})
-        except (OSError, ValueError) as e:
+        except (OSError, ValueError, RuntimeError) as e:
             _M.incr("segment_ingest_failures")
             _LOG.warning("segment ingest failed", dn_id=dn.dn_id,
                          block_id=block_id, seg_index=idx,

@@ -59,7 +59,7 @@ import random
 import time
 
 from hdrf_tpu.storage import stripe_store
-from hdrf_tpu.utils import fault_injection, metrics, retry
+from hdrf_tpu.utils import fault_injection, metrics, qos, retry
 from hdrf_tpu.utils.throttler import Throttler
 
 _S = metrics.registry("scrub")
@@ -229,7 +229,10 @@ class Scrubber:
             cid = cids[self._decode_cursor % len(cids)]
             self._decode_cursor += 1
             man = manifests[cid]
-            got = dn.ec._gather(cid, man)
+            with qos.background():
+                # scrub gathers are background bulk traffic: the control
+                # lane keeps them out of every tenant's admission ledger
+                got = dn.ec._gather(cid, man)
             try:
                 blob = stripe_store.reconstruct_container(got, man)
                 if len(blob) != int(man["length"]):
@@ -254,8 +257,11 @@ class Scrubber:
         self._stripe_crcs.pop((owner, cid, idx), None)
         if owner == dn.dn_id and dn.index.stripe_manifest(cid) is not None:
             host, port = dn.addr
-            dn.ec.repair({"cid": cid, "missing": [idx],
-                          "targets": [[dn.dn_id, host, port]]})
+            with qos.background():
+                # the scrub-triggered repair response runs on the same
+                # control lane as NN-scheduled repairs
+                dn.ec.repair({"cid": cid, "missing": [idx],
+                              "targets": [[dn.dn_id, host, port]]})
         else:
             for nn in dn._nns:
                 try:

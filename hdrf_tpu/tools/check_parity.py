@@ -231,7 +231,14 @@ def main(argv: list[str] | None = None) -> int:
                     root, key="cdc_adaptive.scan_slab_survivors")
                 + check_bench_contract(
                     root, key="cdc_adaptive.mask_bits_effective")
-                + check_bench_contract(root, key="cdc_adaptive.retunes"))
+                + check_bench_contract(root, key="cdc_adaptive.retunes")
+                + check_bench_contract(root, key="coded_exchange")
+                + check_bench_contract(
+                    root, key="coded_exchange.repair_wire_ratio")
+                + check_bench_contract(
+                    root, key="coded_exchange.coded_repairs")
+                + check_bench_contract(
+                    root, key="coded_exchange.pack_saved_frac"))
     for p in problems:
         print(p)
     print(f"{len(problems)} violation(s)" if problems

@@ -96,6 +96,31 @@ def current_tenant() -> str | None:
     return _tenant_var.get()
 
 
+# The control-lane tenant: background bulk work (stripe repair gathers,
+# demote pushes, scrubber decode checks — server/coded_exchange.py) binds
+# this sentinel so the admission gate recognizes it structurally: never
+# shed, never debited against a foreground tenant's bucket, never fed to
+# the deadline estimator.  The queue-side face of the same idea is
+# FairQueue's control lane (FairCallQueue.java:214's control-priority
+# analog); pacing comes from the balance throttle instead of admission.
+BACKGROUND_TENANT = "__background__"
+
+
+@contextlib.contextmanager
+def background():
+    """Bind the background control lane for the with-block: every op
+    inside admits as :data:`BACKGROUND_TENANT` (auditable via the
+    ``qos.admit`` fault point) and can never shed foreground traffic."""
+    with bind_tenant(BACKGROUND_TENANT):
+        yield
+
+
+def is_background(tenant: str | None = None) -> bool:
+    """Is ``tenant`` (default: the ambient one) the control lane?"""
+    t = tenant if tenant is not None else current_tenant()
+    return t == BACKGROUND_TENANT
+
+
 # --------------------------------------------------- deficit token buckets
 
 
@@ -202,6 +227,14 @@ class AdmissionController:
               deadline: retry.Deadline | None = None) -> None:
         """Admission check: raises ShedError, never blocks, charges
         nothing (see ``charge``)."""
+        if tenant == BACKGROUND_TENANT:
+            # control lane: background exchanges are paced by the balance
+            # throttle, never shed, and never touch tenant buckets — but
+            # they still pass the gate so the audit trail (fault point +
+            # counter) proves what lane every op ran under
+            fault_injection.point("qos.admit", tenant=tenant, op=op)
+            _M.incr("background_admits")
+            return
         tenant = tenant or tenants.DEFAULT_TENANT
         fault_injection.point("qos.admit", tenant=tenant, op=op)
         # (a) token bucket: only with a configured rate
@@ -223,6 +256,8 @@ class AdmissionController:
     def charge(self, tenant: str | None, op: str, nbytes: int = 0,
                latency_s: float | None = None) -> None:
         """Book the op's actual cost: bucket debit + service estimator."""
+        if tenant == BACKGROUND_TENANT:
+            return  # control lane: no bucket debit, no estimator samples
         tenant = tenant or tenants.DEFAULT_TENANT
         if self.rate_bytes_s > 0 and nbytes > 0:
             with self._lock:

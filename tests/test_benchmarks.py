@@ -54,6 +54,22 @@ def test_ec_throughput():
     assert o["encode_MBps"] > 0 and o["degraded_read_MBps"] > 0
 
 
+def test_ec_repair_ab_one_json_line():
+    # PR 16 contract: the paired repair harness prints ONE JSON line —
+    # coded partial-sum repair vs the classic full gather, every erasure
+    # pattern oracle-pinned before timing, wire ratio well below k
+    out = run(["ec", "--repair-ab", "--mb", "2", "--policy", "rs-3-2-4k",
+               "--inner", "2", "--dns", "4"])
+    assert len(out) == 1
+    (o,) = out
+    assert o["op"].startswith("ec repair A/B")
+    assert o["parity_oracle_ok"] is True
+    assert o["patterns_pinned"] > 0
+    assert o["repair_wire_ratio_coded"] < o["repair_wire_ratio_full"]
+    assert o["repair_wire_ratio_coded"] <= 1.0 + 1e-6
+    assert abs(o["repair_wire_ratio_full"] - o["k"]) < 1e-6
+
+
 def test_reduction_throughput():
     out = run(["reduction", "--mb", "4", "--backend", "native"])
     assert out[0]["chunks"] > 0

@@ -1,0 +1,471 @@
+"""Coded-exchange shuffle plane (ISSUE 16 / ARCHITECTURE.md design
+decision 16): partial-sum stripe repair, on-TPU compressed exchange
+intermediates, and background-lane scheduling.
+
+Covers ops/rs.py's partial-sum codecs (repair_rows / partial_sums /
+xor_fold) against the GF log/antilog host oracle across EVERY 3-erasure
+pattern of RS(6,3) and the tail-padding edges, the smaller-of LZ4
+negotiation of server/coded_exchange.py (raw wins ties, mixed versions
+stay byte-identical), the QoS control lane (utils/qos.py
+BACKGROUND_TENANT: admitted + audited, NEVER shed, never debits a
+foreground bucket), the coded repair path end to end on a MiniCluster
+(server/ec_tier.py _gather_coded / serve_coded_read — owner ingress
+~|missing| stripes instead of k, measured by the repair_wire_ratio
+ledger), corrupt-contribution-as-erasure handling (the fold's CRC check
+sends the owner to the classic gather, which re-gathers around the
+corrupt survivor), and the mirror-plane segment-compression satellite
+(server/mirror_plane.py seg_enc negotiation behind the
+mirror_compress_segments knob).  Exercises the fault points
+"stripe.coded_read", "coded_exchange.send" and "qos.admit".
+"""
+
+import itertools
+import time
+
+import numpy as np
+import pytest
+
+from hdrf_tpu import native
+from hdrf_tpu.ops import rs
+from hdrf_tpu.server import coded_exchange
+from hdrf_tpu.storage import stripe_store
+from hdrf_tpu.utils import fault_injection, metrics, qos, retry
+
+_EC = metrics.registry("ec")
+_CE = metrics.registry("coded_exchange")
+_QOS = metrics.registry("qos")
+_MIR = metrics.registry("mirror")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    retry.reset_breakers()
+    fault_injection.clear()
+    yield
+    retry.reset_breakers()
+    fault_injection.clear()
+
+
+def _wait(pred, timeout=25.0, interval=0.1, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _bytes(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def _coded_fold(stripes, manifest, missing, holders=3):
+    """Rebuild ``missing`` via per-holder partial sums: survivors are
+    round-robined across ``holders`` simulated DNs, each computes ONE
+    partial_sums call over its local slice, and the folds XOR together —
+    the exact split _gather_coded/serve_coded_read chain performs."""
+    k, m = int(manifest["k"]), int(manifest["m"])
+    shards = {i: np.frombuffer(s, dtype=np.uint8)
+              for i, s in enumerate(stripes) if i not in missing}
+    have = sorted(shards)[:k]
+    rows = rs.repair_rows(k, m, tuple(have), tuple(missing))
+    col = {s: j for j, s in enumerate(have)}
+    parts = []
+    for h in range(holders):
+        mine = have[h::holders]
+        if not mine:
+            continue
+        parts.append(rs.partial_sums(
+            np.stack([shards[s] for s in mine]),
+            rows[:, [col[s] for s in mine]]))
+    return rs.xor_fold(parts)
+
+
+# ------------------------------------------------ partial-sum repair codec
+
+
+class TestPartialSumRepair:
+    K, M = 6, 3
+
+    def test_partial_sums_matches_gf_oracle(self):
+        """The device bit-matmul partial sum is bit-identical to the
+        numpy GF exp/log oracle on random stripes and coefficients."""
+        rng = np.random.default_rng(5)
+        stripes = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
+        coeffs = rng.integers(0, 256, size=(3, 4), dtype=np.uint8)
+        got = rs.partial_sums(stripes, coeffs)
+        ref = rs.partial_sums_ref(stripes, coeffs)
+        assert np.array_equal(got, ref)
+        # zero coefficients contribute nothing
+        z = rs.partial_sums(stripes, np.zeros((2, 4), dtype=np.uint8))
+        assert not z.any()
+
+    def test_fold_bit_identical_on_every_three_erasure_pattern(self):
+        """All C(9,3)=84 erasure patterns of RS(6,3): the XOR-fold of
+        per-holder contributions equals reconstruct_container's full
+        decode, stripe for stripe, bit for bit."""
+        payload = _bytes(6 * 96 + 11, seed=7)
+        stripes, man = stripe_store.encode_container(payload, self.K,
+                                                     self.M)
+        for lost in itertools.combinations(range(self.K + self.M), 3):
+            missing = list(lost)
+            fold = _coded_fold(stripes, man, missing)
+            oracle = stripe_store.reconstruct_container(
+                {i: s for i, s in enumerate(stripes) if i not in lost},
+                man, want=missing)
+            for r, w in enumerate(missing):
+                assert fold[r].tobytes() == oracle[w], \
+                    f"pattern {lost}: stripe {w} diverged"
+
+    def test_single_and_double_erasures_pin_too(self):
+        """Sizes 1 and 2 (the common repair shapes) across every
+        pattern — repair_rows handles data AND parity wants."""
+        payload = _bytes(6 * 64, seed=8)
+        stripes, man = stripe_store.encode_container(payload, self.K,
+                                                     self.M)
+        for width in (1, 2):
+            for lost in itertools.combinations(
+                    range(self.K + self.M), width):
+                fold = _coded_fold(stripes, man, list(lost), holders=2)
+                oracle = stripe_store.reconstruct_container(
+                    {i: s for i, s in enumerate(stripes)
+                     if i not in lost}, man, want=list(lost))
+                for r, w in enumerate(lost):
+                    assert fold[r].tobytes() == oracle[w]
+
+    def test_tail_padding_edges(self):
+        """Payload lengths 0, 1, k-1, k, k+1: stripe_len clamps to >= 1
+        and the fold stays bit-identical through the zero pad."""
+        k = self.K
+        for n in (0, 1, k - 1, k, k + 1):
+            payload = _bytes(n, seed=100 + n)
+            stripes, man = stripe_store.encode_container(payload, k,
+                                                         self.M)
+            assert man["stripe_len"] >= 1
+            missing = [0, k]  # one data, one parity
+            fold = _coded_fold(stripes, man, missing)
+            oracle = stripe_store.reconstruct_container(
+                {i: s for i, s in enumerate(stripes)
+                 if i not in missing}, man, want=missing)
+            for r, w in enumerate(missing):
+                assert fold[r].tobytes() == oracle[w], f"n={n} w={w}"
+
+    def test_corrupt_contribution_surfaces_at_the_fold_crc(self):
+        """A flipped byte in ANY survivor poisons the whole fold (the
+        sum hides which) — the manifest CRC catches it, and the classic
+        CRC-filtering decode over the remaining survivors recovers."""
+        payload = _bytes(6 * 128, seed=9)
+        stripes, man = stripe_store.encode_container(payload, self.K,
+                                                     self.M)
+        missing = [2]
+        corrupt = list(stripes)
+        bad = bytearray(corrupt[4])
+        bad[7] ^= 0x5A
+        corrupt[4] = bytes(bad)
+        fold = _coded_fold(corrupt, man, missing)
+        assert int(native.crc32c(fold[0].tobytes())) \
+            != int(man["crcs"][missing[0]]), \
+            "corrupt contribution went undetected"
+        # erasure fallback: offer every survivor, CRC filter drops the
+        # corrupt one, decode still lands bit-identically
+        offered = {i: corrupt[i] for i in range(self.K + self.M)
+                   if i not in missing}
+        oracle = stripe_store.reconstruct_container(offered, man,
+                                                    want=missing)
+        good = stripe_store.reconstruct_container(
+            {i: stripes[i] for i in range(self.K + self.M)
+             if i not in missing}, man, want=missing)
+        assert oracle[2] == good[2]
+
+
+# ----------------------------------------------- smaller-of negotiation
+
+
+class TestPackNegotiation:
+    def test_round_trip_compressible(self):
+        raw = b"the coded exchange intermediate " * 256
+        blob, enc = coded_exchange.pack(raw)
+        assert enc == 1 and len(blob) < len(raw)
+        assert coded_exchange.unpack(blob, enc, len(raw)) == raw
+
+    def test_incompressible_ships_raw(self):
+        raw = _bytes(4096, seed=11)
+        before = _CE.counter("incompressible_intermediates")
+        blob, enc = coded_exchange.pack(raw)
+        assert enc == 0 and blob == raw
+        assert coded_exchange.unpack(blob, enc, len(raw)) == raw
+        assert _CE.counter("incompressible_intermediates") > before
+
+    def test_tiny_payload_skips_the_codec(self):
+        raw = b"x" * (coded_exchange._MIN_PACK - 1)
+        blob, enc = coded_exchange.pack(raw)
+        assert (blob, enc) == (raw, 0)
+
+    def test_pack_many_alignment_and_ledger(self):
+        datas = [b"a" * 1024, _bytes(1024, seed=12), b"", b"b" * 700]
+        raw0 = _CE.counter("pack_raw_bytes")
+        wire0 = _CE.counter("pack_wire_bytes")
+        out = coded_exchange.pack_many(datas)
+        assert len(out) == len(datas)
+        for d, (p, e) in zip(datas, out):
+            assert coded_exchange.unpack(p, e, len(d)) == d
+            assert len(p) <= len(d)  # negotiation can only save
+        assert _CE.counter("pack_raw_bytes") - raw0 \
+            == sum(len(d) for d in datas)
+        assert _CE.counter("pack_wire_bytes") - wire0 \
+            == sum(len(p) for p, _ in out)
+
+    def test_book_repair_wire_ratio_gauge(self):
+        wire0 = _EC.counter("repair_wire_bytes")
+        rebuilt0 = _EC.counter("repair_rebuilt_bytes")
+        coded_exchange.book_repair_wire(3000, 1000, relay_bytes=2000)
+        assert _EC.counter("repair_wire_bytes") == wire0 + 3000
+        assert _EC.counter("repair_rebuilt_bytes") == rebuilt0 + 1000
+        assert _EC.counter("coded_relay_bytes") >= 2000
+        with _EC._lock:
+            ratio = _EC._gauges["repair_wire_ratio"]
+        assert ratio == pytest.approx(
+            (wire0 + 3000) / (rebuilt0 + 1000))
+
+
+# -------------------------------------------------- background control lane
+
+
+class TestBackgroundLane:
+    def test_background_is_admitted_audited_and_never_shed(self):
+        """The permit/shed audit: exhaust a foreground bucket so IT
+        sheds, then push 100 background admissions + charges through the
+        same controller — zero sheds, zero foreground debits, every
+        admission fires the "qos.admit" audit point under the sentinel
+        tenant, and the foreground world is untouched afterwards."""
+        ctrl = qos.AdmissionController(rate_mb_s=1.0, burst_mb=1.0)
+        ctrl.admit("hog", "stripe_write")
+        ctrl.charge("hog", "stripe_write", 1 << 40)
+        with pytest.raises(qos.ShedError):
+            ctrl.admit("hog", "stripe_write")
+        sheds0 = ctrl.sheds_total()
+        bg0 = _QOS.counter("background_admits")
+        admits = []
+        with fault_injection.inject("qos.admit",
+                                    lambda **kw: admits.append(kw)):
+            with qos.background():
+                assert qos.current_tenant() == qos.BACKGROUND_TENANT
+                assert qos.is_background()
+                for _ in range(100):
+                    ctrl.admit(qos.current_tenant(), "stripe_write")
+                    ctrl.charge(qos.current_tenant(), "stripe_write",
+                                1 << 30)
+        assert ctrl.sheds_total() == sheds0
+        assert qos.BACKGROUND_TENANT not in ctrl.report()["tenant_sheds"]
+        assert _QOS.counter("background_admits") >= bg0 + 100
+        assert len(admits) == 100
+        assert all(a["tenant"] == qos.BACKGROUND_TENANT for a in admits)
+        # 100 GiB of background charges debited NO foreground bucket:
+        # the anon/default lane and a light tenant still admit
+        ctrl.admit(None, "read")
+        ctrl.admit("light", "read")
+        # the lane unbinds on exit
+        assert not qos.is_background()
+
+    def test_background_binding_nests_and_restores(self):
+        with qos.bind_tenant("fg"):
+            with qos.background():
+                assert qos.current_tenant() == qos.BACKGROUND_TENANT
+            assert qos.current_tenant() == "fg"
+
+
+# ------------------------------------------------------------- cluster e2e
+
+
+@pytest.fixture
+def repair_cluster():
+    """5 DNs, tiny containers, RS(3,2) armed; demotion flipped on by the
+    test (same shape as test_ec_cold_tier's cold_cluster)."""
+    from hdrf_tpu.testing.minicluster import MiniCluster
+
+    with MiniCluster(n_datanodes=5, block_size=256 * 1024,
+                     container_size=32 * 1024) as mc:
+        mc.namenode.config.ec_data_shards = 3
+        mc.namenode.config.ec_parity_shards = 2
+        mc.namenode.config.ec_demote_after_s = 0.0
+        yield mc
+
+
+def _demote(mc, c, path, data):
+    c.write(path, data, scheme="dedup_lz4")
+    mc.namenode.config.ec_demote_after_s = 0.3
+    time.sleep(0.3)
+    _wait(lambda: c._call("ec_status")["demoted_blocks"] >= 1,
+          msg="block demotion")
+    _wait(lambda: c._call("ec_status")["striped_containers"] >= 1,
+          msg="striped-container census")
+
+
+def _owner_dn(mc):
+    for dn in mc.datanodes:
+        if dn is not None and dn.index.stats()["striped_containers"] > 0:
+            return dn
+    return None
+
+
+class TestCodedRepairCluster:
+    def test_coded_repair_cuts_owner_ingress_below_k(self, repair_cluster):
+        """The acceptance bar: kill one stripe holder, let the repair
+        monitor run, and the rebuilt stripes must arrive via the
+        partial-sum chain — coded_repairs moves, both new fault points
+        fire on the background tenant, and the wire ledger's delta shows
+        owner ingress ~1x the rebuilt bytes, well below k=3.  Foreground
+        tenants see zero sheds from any of it."""
+        mc = repair_cluster
+        data = _bytes(200_000, seed=17)
+        sends, serves = [], []
+        fault_injection.install(
+            "coded_exchange.send", lambda **kw: sends.append(kw))
+        fault_injection.install(
+            "stripe.coded_read", lambda **kw: serves.append(kw))
+        with mc.client("coded") as c:
+            _demote(mc, c, "/coded/a", data)
+            owner = _owner_dn(mc)
+            assert owner is not None
+            man = next(iter(owner.index.stripe_manifests().values()))
+            victim = next(h[0] for h in man["holders"]
+                          if h[0] != owner.dn_id)
+            coded0 = _EC.counter("coded_repairs")
+            wire0 = _EC.counter("repair_wire_bytes")
+            rebuilt0 = _EC.counter("repair_rebuilt_bytes")
+            repaired0 = _EC.counter("stripes_repaired")
+            sheds0 = _QOS.counter("sheds_total")
+            mc.stop_datanode(int(victim.split("-")[1]))
+            _wait(lambda: _EC.counter("stripes_repaired") > repaired0,
+                  msg="stripe repair")
+            assert _EC.counter("coded_repairs") > coded0, \
+                "repair took the classic gather, not the coded chain"
+            wire = _EC.counter("repair_wire_bytes") - wire0
+            rebuilt = _EC.counter("repair_rebuilt_bytes") - rebuilt0
+            assert rebuilt > 0
+            # owner ingress ~|missing| stripes, not k of them
+            assert wire / rebuilt < int(man["k"]) - 0.5, \
+                f"wire ratio {wire / rebuilt:.2f} not below k"
+            assert sends, "coded_exchange.send never fired"
+            assert all(s["tenant"] == qos.BACKGROUND_TENANT
+                       for s in sends)
+            assert serves, "stripe.coded_read never fired"
+            assert _QOS.counter("sheds_total") == sheds0, \
+                "background repair shed somebody"
+            # the repaired group still reads bit-identically
+            assert c.read("/coded/a") == data
+
+    def test_corrupt_contribution_falls_back_and_still_heals(
+            self, repair_cluster):
+        """Flip a byte in one REMOTE survivor's stripe file, then kill a
+        different holder: the coded fold's CRC check refuses the poisoned
+        rebuild (coded_contrib_corrupt), the owner falls back to the
+        classic gather which treats the corrupt survivor as one more
+        erasure (repair_corrupt_survivors), and the repair still lands."""
+        mc = repair_cluster
+        data = _bytes(150_000, seed=19)
+        with mc.client("corrupt") as c:
+            _demote(mc, c, "/corrupt/a", data)
+            owner = _owner_dn(mc)
+            cid, man = next(iter(
+                owner.index.stripe_manifests().items()))
+            k = int(man["k"])
+            # corrupt a remote DATA holder (always in the coded fold's
+            # first-k survivor pick); kill a PARITY holder
+            corrupt_id = next(man["holders"][i][0] for i in range(k)
+                              if man["holders"][i][0] != owner.dn_id)
+            corrupt_idx = next(i for i in range(k)
+                               if man["holders"][i][0] == corrupt_id)
+            victim = next(man["holders"][i][0]
+                          for i in range(k, k + int(man["m"]))
+                          if man["holders"][i][0]
+                          not in (owner.dn_id, corrupt_id))
+            holder_dn = mc.datanodes[int(corrupt_id.split("-")[1])]
+            path = holder_dn.ec.store._path(owner.dn_id, cid, corrupt_idx)
+            with open(path, "r+b") as f:
+                f.seek(3)
+                b = f.read(1)
+                f.seek(3)
+                f.write(bytes([b[0] ^ 0xFF]))
+            corrupt0 = _EC.counter("coded_contrib_corrupt")
+            fb0 = _EC.counter("coded_repair_fallbacks")
+            repaired0 = _EC.counter("stripes_repaired")
+            mc.stop_datanode(int(victim.split("-")[1]))
+            _wait(lambda: _EC.counter("stripes_repaired") > repaired0,
+                  msg="repair through the corrupt-survivor fallback")
+            assert _EC.counter("coded_contrib_corrupt") > corrupt0, \
+                "the poisoned fold was never detected"
+            assert _EC.counter("coded_repair_fallbacks") > fb0
+            assert c.read("/corrupt/a") == data
+
+    def test_knob_off_pins_the_classic_gather(self):
+        """ec_coded_repair=False is the A/B pin: repair completes on the
+        full gather, no coded counters move, and the ledger's delta
+        ratio sits at ~k (every survivor stripe crosses to the owner)."""
+        from hdrf_tpu.testing.minicluster import MiniCluster
+
+        with MiniCluster(n_datanodes=5, block_size=256 * 1024,
+                         container_size=32 * 1024,
+                         reduction_overrides={
+                             "ec_coded_repair": False,
+                         }) as mc:
+            mc.namenode.config.ec_data_shards = 3
+            mc.namenode.config.ec_parity_shards = 2
+            mc.namenode.config.ec_demote_after_s = 0.0
+            data = _bytes(150_000, seed=23)
+            with mc.client("classic") as c:
+                _demote(mc, c, "/classic/a", data)
+                owner = _owner_dn(mc)
+                man = next(iter(owner.index.stripe_manifests().values()))
+                victim = next(h[0] for h in man["holders"]
+                              if h[0] != owner.dn_id)
+                coded0 = _EC.counter("coded_repairs")
+                wire0 = _EC.counter("repair_wire_bytes")
+                rebuilt0 = _EC.counter("repair_rebuilt_bytes")
+                repaired0 = _EC.counter("stripes_repaired")
+                mc.stop_datanode(int(victim.split("-")[1]))
+                _wait(lambda: _EC.counter("stripes_repaired") > repaired0,
+                      msg="classic stripe repair")
+                assert _EC.counter("coded_repairs") == coded0
+                wire = _EC.counter("repair_wire_bytes") - wire0
+                rebuilt = _EC.counter("repair_rebuilt_bytes") - rebuilt0
+                assert wire / rebuilt > int(man["k"]) - 0.5
+                assert c.read("/classic/a") == data
+
+
+# ------------------------------------------- mirror segment compression
+
+
+class TestMirrorSegmentCompression:
+    def _run(self, overrides):
+        from hdrf_tpu.testing.minicluster import MiniCluster
+
+        # "dedup" (container_codec=none) keeps the reduced chunks raw, so
+        # the mirrored payload is still compressible and the smaller-of
+        # negotiation has something to win on; unique counters per line
+        # keep the chunks from deduping away
+        data = b"".join(b"mirror segment compression %08d\n" % i
+                        for i in range(4000))
+        with MiniCluster(n_datanodes=3, replication=3,
+                         block_size=1 << 20,
+                         reduction_overrides=overrides) as mc:
+            with mc.client("mseg") as c:
+                c.write("/mseg/f", data, scheme="dedup")
+                assert c.read("/mseg/f") == data
+
+    def test_segments_compress_behind_the_knob(self):
+        before = _MIR.counter("segments_compressed")
+        raw0 = _MIR.counter("segment_raw_bytes")
+        wire0 = _MIR.counter("segment_wire_bytes")
+        self._run({"mirror_parity": 1})
+        assert _MIR.counter("segments_compressed") > before
+        saved = ((_MIR.counter("segment_raw_bytes") - raw0)
+                 - (_MIR.counter("segment_wire_bytes") - wire0))
+        assert saved > 0, "compressed segments saved no wire bytes"
+
+    def test_knob_off_pins_the_raw_path(self):
+        before = _MIR.counter("segments_compressed")
+        self._run({"mirror_parity": 1,
+                   "mirror_compress_segments": False})
+        assert _MIR.counter("segments_compressed") == before
