@@ -711,6 +711,59 @@ def _multichip_summary() -> dict:
     return out
 
 
+def _longhorizon_summary() -> dict:
+    """Long-horizon churn stamp for the JSON line: the `benchmarks churn`
+    sub-harness (delete/rewrite lifecycle over a MiniCluster; the
+    storage_ratio / garbage / cache / read-p95 curves over time that
+    ROADMAP item 1 calls the honest production number) run in a CHILD
+    process on the clean CPU env — churn drives a whole MiniCluster and
+    must not share the parent's possibly-TPU-held backend.  The child's
+    single JSON line is folded into a flat first/last/slope stamp; any
+    failure degrades to ``{"ok": False, ...}`` so a churn regression can
+    never take down the bench line itself."""
+    import subprocess
+
+    from hdrf_tpu.utils.cleanenv import clean_cpu_env
+
+    smoke = os.environ.get("HDRF_BENCH_SMOKE") == "1"
+    argv = [sys.executable, "-m", "hdrf_tpu.benchmarks", "churn"]
+    if smoke:
+        argv += ["--rounds", "3", "--files", "3", "--kb", "8"]
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=600,
+            env=clean_cpu_env(8), cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = proc.stdout.strip().splitlines()[-1]
+        out = json.loads(line)
+    except Exception as e:          # noqa: BLE001 — stamp must never raise
+        return {"ok": False, "error": repr(e)[:200],
+                "storage_ratio_slope": 0.0}
+    if proc.returncode != 0:
+        return {"ok": False, "error": proc.stderr.strip()[-200:],
+                "storage_ratio_slope": 0.0}
+    curves = out.get("curves", {})
+
+    def _c(metric, field):
+        return round(float(curves.get(metric, {}).get(field, 0.0)), 4)
+
+    return {
+        "rounds": out.get("rounds", 0),
+        "samples": out.get("samples", 0),
+        "storage_ratio_first": _c("storage_ratio", "first"),
+        "storage_ratio_last": _c("storage_ratio", "last"),
+        "storage_ratio_slope": _c("storage_ratio", "slope"),
+        "garbage_bytes_last": _c("garbage_bytes", "last"),
+        "chunk_cache_hit_ratio_last": _c("chunk_cache_hit_ratio", "last"),
+        "read_p95_ms_slope": _c("read_p95_ms", "slope"),
+        "regressions": out.get("regressions", []),
+        "verdict": out.get("verdict", ""),
+        # churn MUST show the ratio decaying: deletes leave dead chunks in
+        # sealed containers, so a flat curve means the census lies
+        "ok": bool(out.get("verdict") == "REGRESSED"
+                   and "storage_ratio" in (out.get("regressions") or [])),
+    }
+
+
 def _phase_profile(t0: float, t1: float) -> dict:
     """Cross-thread overlap profile of [t0, t1] for the JSON line: wall
     partitioned into the profiler's exclusive classes (host/device busy,
@@ -802,6 +855,7 @@ def main() -> None:
                 "phase_profile": phase_profile,
                 "pipeline": _pipeline_summary(phase_profile),
                 "multichip": _multichip_summary(),
+                "longhorizon": _longhorizon_summary(),
             }))
             return
 
@@ -1135,6 +1189,7 @@ def main() -> None:
             "phase_profile": phase_profile,
             "pipeline": _pipeline_summary(phase_profile),
             "multichip": _multichip_summary(),
+            "longhorizon": _longhorizon_summary(),
         }))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -10,6 +10,10 @@ Analogs of the reference's test-tree benchmarks:
              (ErasureCodeBenchmarkThroughput.java).
 - ``reduction`` — the block-reduction pipeline (what bench.py at the repo
              root reports to the driver), selectable backend.
+- ``churn`` — long-horizon delete/rewrite lifecycle over a MiniCluster:
+             storage_ratio / garbage / cache / read-p95 curves over time
+             (no reference analog; the trajectory axis ROADMAP item 1
+             calls the honest production number).
 
 Run: ``python -m hdrf_tpu.benchmarks <which> [options]``; each prints
 one JSON object per metric.
@@ -937,6 +941,90 @@ def bench_multichip(args) -> None:
     }))
 
 
+def bench_churn(args) -> None:
+    """Long-horizon churn scenario (ISSUE 17 tentpole d; ROADMAP item 1's
+    "storage_ratio and read p95 over time is the honest production
+    number").  Drives a delete-heavy / rewrite lifecycle over a 1-DN
+    MiniCluster: every round writes a generation of dedup-friendly files
+    (a shared tile plus a unique tail), deletes a fraction of the oldest
+    generation, rewrites a fraction of the survivors, reads everything
+    still live, runs one scrub census cycle, and takes one deterministic
+    flight-recorder sample (utils/flight_recorder.py sample_once — the
+    thread is cadence, never semantics).
+
+    Deletes shrink the DN's LOGICAL footprint (replica block report)
+    while the already-sealed containers keep their PHYSICAL bytes, so the
+    storage_ratio curve (physical/logical, server/datanode.py
+    _flight_sample) degrades UPWARD round over round and the scrub census
+    counts the dead chunks as garbage_bytes — the trend report
+    (tools/slo_report.py trend) must flag it REGRESS_UP.  Prints exactly
+    ONE JSON line: the per-metric first/last/slope curve summary plus the
+    trend verdict."""
+    import random
+
+    from hdrf_tpu.testing.minicluster import MiniCluster
+    from hdrf_tpu.tools import slo_report
+
+    rng = random.Random(0x17)
+    kb = args.kb
+    shared = bytes(rng.getrandbits(8) for _ in range(kb << 10))
+
+    def payload() -> bytes:
+        return shared + bytes(rng.getrandbits(8) for _ in range(kb << 10))
+
+    samples: list[dict] = []
+    live: list[str] = []
+    gen = 0
+    with MiniCluster(n_datanodes=1, replication=1) as mc:
+        dn = mc.datanodes[0]
+        with mc.client("churn") as c:
+            for _ in range(args.rounds):
+                for i in range(args.files):
+                    path = f"/churn/g{gen}/f{i}"
+                    c.write(path, payload(), scheme="dedup_lz4")
+                    live.append(path)
+                gen += 1
+                ndel = int(len(live) * args.delete_frac)
+                for path in live[:ndel]:
+                    c.delete(path)
+                live = live[ndel:]
+                nrw = int(len(live) * args.rewrite_frac)
+                for path in live[:nrw]:
+                    c.delete(path)
+                    c.write(path, payload(), scheme="dedup_lz4")
+                # deletes reach the DN as invalidate commands riding
+                # heartbeats (~0.2 s in MiniCluster): wait for the
+                # replica count to settle so the logical census is honest
+                deadline = time.monotonic() + 5.0
+                while (len(dn.replicas.block_ids()) > len(live)
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                for path in live:
+                    c.read(path)
+                dn.scrubber.run_cycle()
+                samples.append(dn.flight.sample_once())
+    curves = {}
+    for metric in ("storage_ratio", "garbage_bytes",
+                   "chunk_cache_hit_ratio", "read_p95_ms"):
+        vals = [float(s.get(metric, 0.0)) for s in samples]
+        curves[metric] = {"first": vals[0], "last": vals[-1],
+                          "slope": slo_report.slope(vals),
+                          "series": vals}
+    tr = slo_report.trend(samples)
+    print(json.dumps({
+        "op": "churn [delete/rewrite lifecycle, flight-sampled]",
+        "rounds": args.rounds,
+        "files_per_round": args.files,
+        "kb": kb,
+        "delete_frac": args.delete_frac,
+        "rewrite_frac": args.rewrite_frac,
+        "samples": len(samples),
+        "curves": curves,
+        "regressions": tr["regressions"],
+        "verdict": tr["verdict"],
+    }))
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="hdrf-bench")
     sub = p.add_subparsers(dest="which", required=True)
@@ -1024,6 +1112,19 @@ def main(argv: list[str] | None = None) -> int:
     d.add_argument("--chunk-kb", type=int, default=0,
                    help="target avg chunk KiB (0 = config default ~8)")
     d.set_defaults(fn=bench_recon)
+    d = sub.add_parser("churn")
+    d.add_argument("--rounds", type=int, default=6,
+                   help="churn generations (one flight sample each)")
+    d.add_argument("--files", type=int, default=6,
+                   help="files written per generation")
+    d.add_argument("--kb", type=int, default=64,
+                   help="shared-tile and unique-tail size per file (KiB)")
+    d.add_argument("--delete-frac", type=float, default=0.4,
+                   help="fraction of the oldest live files deleted per "
+                        "round")
+    d.add_argument("--rewrite-frac", type=float, default=0.2,
+                   help="fraction of survivors rewritten per round")
+    d.set_defaults(fn=bench_churn)
     args = p.parse_args(argv)
     args.fn(args)
     return 0

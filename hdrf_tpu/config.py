@@ -306,6 +306,13 @@ class NameNodeConfig:
     # until sample_once is driven).
     flight_interval_s: float = 1.0
     flight_capacity: int = 512
+    # Flight archive (utils/flight_archive.py): crash-safe JSONL
+    # persistence of every flight sample, so daemon restarts keep the
+    # long-horizon curve.  Empty dir disables; a relative dir resolves
+    # under the metadata dir.  max_mb bounds the on-disk history (oldest
+    # sealed segments GC'd first).
+    flight_archive_dir: str = ""
+    flight_archive_max_mb: int = 64
 
 
 @dataclass
@@ -373,6 +380,11 @@ class DataNodeConfig:
     # disables the sampler thread.
     flight_interval_s: float = 1.0
     flight_capacity: int = 512
+    # Flight archive (utils/flight_archive.py): crash-safe JSONL
+    # persistence of flight samples (restart-surviving /timeseries).
+    # Empty dir disables; a relative dir resolves under data_dir.
+    flight_archive_dir: str = ""
+    flight_archive_max_mb: int = 64
     # Continuous integrity scrub (server/scrubber.py): background cycle
     # re-verifying sealed containers / EC stripes / replica invariants and
     # taking the garbage census.  interval <= 0 disables the loop (the

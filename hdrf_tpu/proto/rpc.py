@@ -26,7 +26,7 @@ from typing import Any
 
 import msgpack
 
-from hdrf_tpu.utils import metrics, retry, tenants, tracing
+from hdrf_tpu.utils import metrics, retry, rollwin, tenants, tracing
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
@@ -91,6 +91,12 @@ class RpcServer:
         self._metrics = metrics.registry(f"rpc.{name}")
         self._tracer = tracing.tracer(f"rpc.{name}")
         self._watchdog = watchdog
+        # Metadata-plane latency axis (RpcMetrics#addRpcProcessingTime
+        # analog): per-method histograms + one rolling window feeding a
+        # p99 gauge into the NN flight record.  NN-only — the DN control
+        # plane has no RPC server of its own worth the extra books.
+        self._lat_win = (rollwin.RollingWindow(window_s=300.0, maxlen=512)
+                        if name == "namenode" else None)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -120,6 +126,14 @@ class RpcServer:
     @property
     def addr(self) -> tuple[str, int]:
         return self._server.server_address  # resolved (host, real_port)
+
+    def rpc_p99_ms(self) -> float:
+        """Rolling p99 RPC processing latency (ms) over the last window —
+        the ``nn_rpc_p99_ms`` gauge the NN flight record samples."""
+        if self._lat_win is None:
+            return 0.0
+        q = self._lat_win.quantiles((99,))
+        return (q or {}).get("p99", 0.0) / 1e3
 
     def _dispatch(self, req: list) -> list:
         req_id, method, kwargs = req
@@ -177,6 +191,10 @@ class RpcServer:
             except Exception as e:  # noqa: BLE001 — errors cross the wire
                 self._metrics.incr(f"{method}_errors")
                 out = [1, {"error": type(e).__name__, "message": str(e)}]
+        if self._lat_win is not None:
+            dt_us = (time.perf_counter() - t_start) * 1e6
+            self._metrics.observe(f"nn_rpc_us|method={method}", dt_us)
+            self._lat_win.add(dt_us)
         if tenant is not None:  # wire calls carrying a client id only
             tenants.note_op(tenant, f"rpc.{method}",
                             latency_s=time.perf_counter() - t_start)

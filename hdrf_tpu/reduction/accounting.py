@@ -202,6 +202,13 @@ def record_retune(key: str, old, new) -> None:
     _ACC.incr(f"cdc_retunes__{key}")
 
 
+def record_retune_rollback() -> None:
+    """One guard-triggered geometry revert (tools/slo_report.py guard
+    called from the DN tick): the counter is the e2e proof the regression
+    guard actually protects the workload, not just flags it."""
+    _ACC.incr("retune_rollbacks")
+
+
 def dedup_counters() -> tuple[int, int]:
     """Cumulative (hit, miss) dedup chunk counters — the controller's
     observation signal, produced by record_dedup_block at the commit
@@ -257,6 +264,11 @@ class AdaptiveChunkController:
         self._seen_miss = 0
         self._win_hit = 0
         self._win_miss = 0
+        # Windows still held after a guard rollback (slo_report.guard in
+        # the DN tick): a retune the guard just reverted must not be
+        # re-proposed from the very next window's evidence, or the loop
+        # flaps retune/rollback forever.
+        self._hold_windows = 0
 
     def geometry(self, mask_bits: int) -> tuple[int, int]:
         """(min_chunk, max_chunk) for a mask-bits setting."""
@@ -286,6 +298,9 @@ class AdaptiveChunkController:
             return []
         ratio = self._win_hit / total
         self._win_hit = self._win_miss = 0
+        if self._hold_windows > 0:
+            self._hold_windows -= 1
+            return []
         cur = int(current_mask_bits)
         if ratio < self.LOW_HIT:
             new = min(cur + 1, self.MASK_BITS_MAX)
@@ -296,6 +311,13 @@ class AdaptiveChunkController:
         if new == cur:
             return []
         return self.steps(cur, new)
+
+    def note_rollback(self, hold_windows: int = 2) -> None:
+        """The regression guard reverted the last retune: hold the next
+        ``hold_windows`` full observation windows before proposing any
+        new geometry, so a workload the guard judged worse under the new
+        cuts cannot re-trigger the same retune immediately."""
+        self._hold_windows = max(self._hold_windows, int(hold_windows))
 
     def steps(self, old_mask_bits: int,
               new_mask_bits: int) -> list[tuple[str, int]]:

@@ -43,9 +43,9 @@ from hdrf_tpu.server.block_receiver import BlockReceiver
 from hdrf_tpu.server.block_sender import BlockSender
 from hdrf_tpu.server.status_http import StatusHttpServer
 from hdrf_tpu.reduction import accounting
-from hdrf_tpu.utils import (device_ledger, fault_injection, flight_recorder,
-                            log, metrics, profiler, qos, retry, rollwin,
-                            tenants, tracing)
+from hdrf_tpu.utils import (device_ledger, fault_injection, flight_archive,
+                            flight_recorder, log, metrics, profiler, qos,
+                            retry, rollwin, tenants, tracing)
 from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("datanode")
@@ -311,6 +311,11 @@ class DataNode:
             self._cdc_controller = accounting.AdaptiveChunkController(
                 target_mask_bits=red.cdc_target_mask_bits,
                 min_size=red.cdc_min_size)
+        # Post-retune regression guard (tools/slo_report.py guard): armed
+        # after every applied retune with a baseline of recent flight
+        # samples; once enough post-retune samples accrue, a regressing
+        # window rolls the geometry back through reconfigure().
+        self._cdc_guard: dict | None = None
         # Admission control: bounded slots instead of ticket queues.
         self._write_sem = threading.Semaphore(red.max_concurrent_writes)
         self._read_sem = threading.Semaphore(red.max_concurrent_reads)
@@ -414,11 +419,21 @@ class DataNode:
                                       budget_s=config.stall_budget_s,
                                       registry=_M)
         # Flight recorder: over-time curve of this DN's key gauges,
-        # served as /timeseries (utils/flight_recorder.py).
+        # served as /timeseries (utils/flight_recorder.py); optionally
+        # backed by a crash-safe archive so the curve survives restarts
+        # (utils/flight_archive.py).
+        self.flight_archive = None
+        if config.flight_archive_dir:
+            arch_dir = config.flight_archive_dir
+            if not os.path.isabs(arch_dir):
+                arch_dir = os.path.join(config.data_dir, arch_dir)
+            self.flight_archive = flight_archive.FlightArchive(
+                arch_dir, max_bytes=config.flight_archive_max_mb << 20)
         self.flight = flight_recorder.FlightRecorder(
             self.dn_id, self._flight_sample,
             interval_s=config.flight_interval_s,
-            capacity=config.flight_capacity)
+            capacity=config.flight_capacity,
+            archive=self.flight_archive)
         self._status = None
         if config.status_port is not None:
             self._status = StatusHttpServer(self.dn_id, host=config.host,
@@ -504,6 +519,8 @@ class DataNode:
         self._stop.set()
         self.watchdog.stop()
         self.flight.stop()
+        if self.flight_archive is not None:
+            self.flight_archive.close()
         if self._status is not None:
             self._status.stop()
         self._sc.stop()
@@ -662,6 +679,17 @@ class DataNode:
                 # worker's so callers never need the worker addr.  Served
                 # OUTSIDE the xceiver span so polling never pollutes traces.
                 self._serve_trace_spans(sock)
+                return
+            if op == "flight_timeseries":
+                # Long-horizon poll (gateway /timeseries?scope=cluster
+                # fan-out): ring + archive merged, filtered, tail-limited
+                # (utils/flight_archive.py query).  Same no-span rule as
+                # trace_spans — polling must not pollute observability.
+                send_frame(sock, flight_archive.query(
+                    self.flight, self.flight_archive,
+                    metric=fields.get("metric"),
+                    since=fields.get("since"),
+                    limit=int(fields.get("limit") or 2048)))
                 return
             with retry.bind_remaining(fields.get(retry.DEADLINE_KEY)), \
                     self.watchdog.track(f"xceiver.{op}"), \
@@ -918,13 +946,24 @@ class DataNode:
         reconfigure steps it emits through the SAME validated reconfigure
         path an operator uses.  A rejected step (bounds, transient
         min>max the ordering should have prevented) abandons the retune —
-        the controller re-decides next window from fresh evidence."""
+        the controller re-decides next window from fresh evidence.
+
+        Every APPLIED retune arms the regression guard (ROADMAP item 5's
+        "a bad retune rolls itself back"): the flight ring's most recent
+        samples become the baseline; once enough post-retune samples
+        accrue, tools/slo_report.py's guard() compares the windows and a
+        direction-aware regression reverts the geometry through the same
+        reconfigure path, counts ``retune_rollbacks``, and holds the
+        controller for two observation windows so the loop cannot flap."""
         ctl = self._cdc_controller
         if ctl is None:
             return
+        self._cdc_guard_tick(ctl)
         hit, miss = accounting.dedup_counters()
         cdc = self.reduction_ctx.config.cdc
-        steps = ctl.observe(hit, miss, cdc.mask_bits)
+        old_bits = cdc.mask_bits
+        steps = ctl.observe(hit, miss, old_bits)
+        applied = False
         for key, value in steps:
             r = self.reconfigure(key, value)
             if not r.get("ok"):
@@ -933,6 +972,51 @@ class DataNode:
                                   key, value, r.get("error"))
                 return
             accounting.record_retune(key, r["old"], r["new"])
+            applied = True
+        if applied:
+            self._arm_cdc_guard(old_bits, self.reduction_ctx.config.cdc.mask_bits)
+
+    GUARD_GAUGES = ("dedup_ratio", "storage_ratio",
+                    "write_p95_ms", "read_p95_ms")
+    GUARD_MIN_SAMPLES = 3
+
+    def _arm_cdc_guard(self, old_bits: int, new_bits: int) -> None:
+        samples = self.flight.snapshot()["samples"]
+        self._cdc_guard = {
+            "old_bits": int(old_bits), "new_bits": int(new_bits),
+            "baseline": samples[-8:],
+            "armed_mono": samples[-1]["mono"] if samples else 0.0}
+
+    def _cdc_guard_tick(self, ctl) -> None:
+        """Evaluate an armed retune guard once enough post-retune flight
+        samples exist; regress -> revert geometry + hold the controller."""
+        guard = self._cdc_guard
+        if guard is None or not guard["baseline"]:
+            return
+        from hdrf_tpu.tools import slo_report
+
+        samples = self.flight.snapshot()["samples"]
+        current = [s for s in samples if s["mono"] > guard["armed_mono"]]
+        if len(current) < self.GUARD_MIN_SAMPLES:
+            return
+        self._cdc_guard = None
+        verdict = slo_report.guard(guard["baseline"], current,
+                                   gauges=self.GUARD_GAUGES)
+        if not verdict["regressed"]:
+            return
+        for key, value in ctl.steps(guard["new_bits"], guard["old_bits"]):
+            r = self.reconfigure(key, value)
+            if not r.get("ok"):
+                _M.incr("cdc_retune_rejected")
+                return
+        accounting.record_retune_rollback()
+        ctl.note_rollback()
+        _M.incr("cdc_guard_rollbacks")
+        self._log.warning("cdc retune rolled back by regression guard",
+                          dn_id=self.dn_id,
+                          regressions=[r["metric"]
+                                       for r in verdict["rows"]
+                                       if r.get("regressed")])
 
     def _lifeline_loop(self) -> None:
         """DatanodeLifelineProtocol analog: a LOW-COST liveness-only

@@ -49,7 +49,8 @@ from urllib.parse import parse_qs, quote, unquote, urlparse
 import msgpack
 
 from hdrf_tpu.client.filesystem import HdrfClient
-from hdrf_tpu.utils import device_ledger, log, metrics, prom, tracing
+from hdrf_tpu.utils import (device_ledger, flight_archive, log, metrics,
+                            prom, tracing)
 
 _M = metrics.registry("http_gateway")
 _LOG = log.get_logger("http_gateway")
@@ -157,7 +158,11 @@ class HttpGateway:
                     if u.path == "/stacks":
                         return self._json(200, gateway.stacks())
                     if u.path == "/timeseries":
-                        return self._json(200, gateway.timeseries())
+                        return self._json(200, gateway.timeseries(
+                            scope=q.get("scope"),
+                            metric=q.get("metric"),
+                            since=q.get("since"),
+                            step=q.get("step")))
                     if u.path == "/fsck":
                         return self._json(200, gateway.fsck())
                     if not u.path.startswith(PREFIX):
@@ -604,17 +609,65 @@ class HttpGateway:
 
         return {"daemon": "http_gateway", "threads": thread_stacks()}
 
-    def timeseries(self) -> dict:
-        """The NameNode flight recorder's ring (flight_timeseries RPC;
-        per-DN rings live on each DN's own /timeseries status endpoint) —
-        the time-series the slo_report tool plots."""
+    def timeseries(self, scope: str | None = None,
+                   metric: str | None = None, since=None,
+                   step=None) -> dict:
+        """The flight-data query plane (the time-series slo_report plots).
+
+        Default scope: the NameNode flight recorder's ring+archive
+        (flight_query RPC), ``?metric=``/``?since=`` projected
+        server-side.  ``?scope=cluster``: pull every live DN's
+        ring+archive too (flight_timeseries xceiver op, the /traces
+        fan-out pattern), align the per-daemon streams into one cluster
+        series with proper per-gauge merge semantics — quantile-class
+        gauges take the MAX across nodes, per-node tallies SUM, ratios
+        average (utils/flight_archive.py merge_cluster) — and, when
+        ``?step=`` is given, downsample to min/max/mean/last rollup
+        buckets so an archive of any length renders in one bounded
+        response."""
+        since_f = float(since) if since is not None else None
+        step_f = float(step) if step is not None else None
         try:
             with HdrfClient(self._nn_addr, name="http-gw") as c:
-                return c._call("flight_timeseries")
+                nn = c._call("flight_query", metric=metric, since=since_f)
+                report = (c.datanode_report()
+                          if scope == "cluster" else [])
         except (OSError, ConnectionError):
             _M.incr("timeseries_nn_unreachable")
             return {"daemon": "namenode", "interval_s": 0.0, "capacity": 0,
                     "samples": [], "error": "namenode unreachable"}
+        if scope != "cluster":
+            if step_f:
+                nn["rollup"] = flight_archive.rollup(nn["samples"], step_f)
+                nn["samples"] = []
+            return nn
+        import socket as _socket
+
+        from hdrf_tpu.proto import datatransfer as dt
+        from hdrf_tpu.proto.rpc import recv_frame
+
+        series = [("namenode", nn.get("samples") or [])]
+        for d in report:
+            if not d.get("alive"):
+                continue
+            try:
+                with _socket.create_connection(
+                        tuple(d["addr"]), timeout=5.0) as s:
+                    dt.send_op(s, "flight_timeseries",
+                               metric=metric, since=since_f)
+                    out = recv_frame(s)
+                series.append((out.get("daemon") or d.get("dn_id", "dn"),
+                               out.get("samples") or []))
+            except (OSError, ConnectionError):
+                _M.incr("timeseries_dn_unreachable")
+        bucket = step_f or 1.0
+        merged = flight_archive.merge_cluster(series, step_s=bucket)
+        out = {"scope": "cluster", "step_s": bucket,
+               "daemons": [name for name, _ in series],
+               "samples": merged}
+        if step_f:
+            out["rollup"] = flight_archive.rollup(merged, step_f)
+        return out
 
     # ------------------------------------------------------------- web UIs
 

@@ -23,6 +23,7 @@ correct first, sharded later if metadata ops ever become the bottleneck.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -34,8 +35,9 @@ from hdrf_tpu.proto.rpc import RpcError, RpcServer
 from hdrf_tpu.server import permissions as perm
 from hdrf_tpu.server.editlog import EditLog
 from hdrf_tpu.server.permissions import Attrs, DirNode
-from hdrf_tpu.utils import (fault_injection, flight_recorder, log, metrics,
-                            outlier, retry, tenants, tracing)
+from hdrf_tpu.utils import (fault_injection, flight_archive,
+                            flight_recorder, log, metrics, outlier, retry,
+                            tenants, tracing)
 from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("namenode")
@@ -392,11 +394,21 @@ class NameNode:
                               "namenode", watchdog=self.watchdog)
         # Cluster-level flight recorder (utils/flight_recorder.py): exists
         # even without a status port — the gateway pulls its ring over the
-        # flight_timeseries RPC.
+        # flight_timeseries RPC.  Optionally archive-backed so the curve
+        # survives NN restarts (utils/flight_archive.py).
+        self.flight_archive = None
+        if self.config.flight_archive_dir:
+            arch_dir = self.config.flight_archive_dir
+            if not os.path.isabs(arch_dir):
+                arch_dir = os.path.join(self.config.meta_dir, arch_dir)
+            self.flight_archive = flight_archive.FlightArchive(
+                arch_dir,
+                max_bytes=self.config.flight_archive_max_mb << 20)
         self.flight = flight_recorder.FlightRecorder(
             "namenode", self._flight_sample,
             interval_s=self.config.flight_interval_s,
-            capacity=self.config.flight_capacity)
+            capacity=self.config.flight_capacity,
+            archive=self.flight_archive)
         self._status = None
         if self.config.status_port is not None:
             from hdrf_tpu.server.status_http import StatusHttpServer
@@ -432,6 +444,8 @@ class NameNode:
     def stop(self) -> None:
         self._monitor_stop.set()
         self.flight.stop()
+        if self.flight_archive is not None:
+            self.flight_archive.close()
         self.watchdog.stop()
         if self._status is not None:
             self._status.stop()
@@ -3284,12 +3298,25 @@ class NameNode:
         states = [b.state for b in retry.all_breakers().values()]
         sample["breakers_open"] = sum(1 for s in states if s == "open")
         sample["tenant_count"] = tenants.tenant_count()
+        # Metadata-plane latency health (ROADMAP item 2's axis): rolling
+        # p99 over every RPC the server dispatched in the last window.
+        sample["nn_rpc_p99_ms"] = self._rpc.rpc_p99_ms()
         return sample
 
     def rpc_flight_timeseries(self) -> dict:
         """The NN flight recorder's bounded ring, for the gateway's
         /timeseries endpoint (same pull model as rpc_trace_spans)."""
         return self.flight.snapshot()
+
+    def rpc_flight_query(self, metric=None, since=None,
+                         limit: int = 2048) -> dict:
+        """Long-horizon flight query: ring + crash-safe archive merged,
+        de-duplicated, ``metric``/``since`` filtered and tail-limited
+        (utils/flight_archive.py query) — the restart-surviving sibling
+        of rpc_flight_timeseries the gateway's cluster scope pulls."""
+        return flight_archive.query(self.flight, self.flight_archive,
+                                    metric=metric, since=since,
+                                    limit=int(limit or 2048))
 
     def rpc_trace_spans(self) -> dict:
         """This process's finished spans + device-ledger events, for the

@@ -82,8 +82,9 @@ class StatusHttpServer:
                                       json.dumps(status.stacks()).encode(),
                                       "application/json")
                 if u.path == "/timeseries":
-                    return self._send(200,
-                                      json.dumps(status.timeseries()).encode(),
+                    out = status.timeseries(metric=q.get("metric"),
+                                            since=q.get("since"))
+                    return self._send(200, json.dumps(out).encode(),
                                       "application/json")
                 self._send(404, b'{"error": "not found"}',
                            "application/json")
@@ -116,14 +117,25 @@ class StatusHttpServer:
         return {"daemon": self.name, "spans": spans, "ledger": ledger,
                 "counters": counters}
 
-    def timeseries(self) -> dict:
+    def timeseries(self, metric: str | None = None,
+                   since=None) -> dict:
         """The flight recorder's ring (utils/flight_recorder.py), or an
         empty shell when the daemon runs without a recorder — the endpoint
-        shape stays stable either way."""
+        shape stays stable either way.  ``?metric=`` (comma-separated
+        gauge names) and ``?since=`` (wall seconds) project the ring down
+        (utils/flight_archive.py filter_series) so pollers stop paying
+        for the full dump."""
         if self._recorder is None:
             return {"daemon": self.name, "interval_s": 0.0, "capacity": 0,
                     "samples": []}
-        return self._recorder.snapshot()
+        out = self._recorder.snapshot()
+        if metric or since is not None:
+            from hdrf_tpu.utils import flight_archive
+
+            out["samples"] = flight_archive.filter_series(
+                out["samples"], metric=metric,
+                since=float(since) if since is not None else None)
+        return out
 
     def stacks(self) -> dict:
         out = {"daemon": self.name, "threads": thread_stacks()}

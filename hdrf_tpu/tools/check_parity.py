@@ -238,7 +238,10 @@ def main(argv: list[str] | None = None) -> int:
                 + check_bench_contract(
                     root, key="coded_exchange.coded_repairs")
                 + check_bench_contract(
-                    root, key="coded_exchange.pack_saved_frac"))
+                    root, key="coded_exchange.pack_saved_frac")
+                + check_bench_contract(root, key="longhorizon")
+                + check_bench_contract(
+                    root, key="longhorizon.storage_ratio_slope"))
     for p in problems:
         print(p)
     print(f"{len(problems)} violation(s)" if problems

@@ -40,7 +40,7 @@ class FlightRecorder:
 
     def __init__(self, name: str, sample_fn: Callable[[], dict],
                  interval_s: float = 1.0, capacity: int = 512,
-                 clock=time.monotonic, wall=time.time):
+                 clock=time.monotonic, wall=time.time, archive=None):
         self.name = name
         self.interval_s = float(interval_s)
         self.capacity = int(capacity)
@@ -51,6 +51,13 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self.archive = archive
+        if archive is not None:
+            # Restart survival: re-seed the ring from the archive's tail
+            # (utils/flight_archive.py replay — torn tails already
+            # dropped), so /timeseries shows pre-crash history at once.
+            for s in archive.replay(limit=self.capacity):
+                self._ring.append(s)
 
     def sample_once(self) -> dict[str, Any]:
         """Take one sample inline (the thread's body; tests call it
@@ -64,6 +71,11 @@ class FlightRecorder:
         sample = {"t": self._wall(), "mono": self._clock(), **gauges}
         with self._lock:
             self._ring.append(sample)
+        if self.archive is not None:
+            try:
+                self.archive.append(sample)
+            except (OSError, ValueError):  # ValueError: closed archive
+                _M.incr("archive_errors")
         _M.incr("samples_total")
         return sample
 

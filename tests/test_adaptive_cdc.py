@@ -74,6 +74,25 @@ class TestController:
         assert ctl._win_hit == ctl._win_miss == 0
         assert ctl.observe(0, 30, 13) == []             # 20 new misses only
 
+    def test_rollback_hold_sits_out_full_windows(self):
+        """After a guard rollback the controller must not re-propose the
+        same retune from the very next window: note_rollback(2) consumes
+        two FULL dedup-poor windows before deciding again."""
+        ctl = AdaptiveChunkController(window_chunks=64)
+        ctl.note_rollback(hold_windows=2)
+        assert ctl.observe(0, 64, 13) == []     # full poor window: held
+        assert ctl.observe(0, 128, 13) == []    # second window: held
+        steps = ctl.observe(0, 192, 13)         # hold expired: decides
+        assert steps and steps[-1] == ("cdc_mask_bits", 14)
+
+    def test_hold_only_burns_on_full_windows(self):
+        ctl = AdaptiveChunkController(window_chunks=64)
+        ctl.note_rollback(hold_windows=1)
+        assert ctl.observe(0, 10, 13) == []     # partial: hold untouched
+        assert ctl._hold_windows == 1
+        assert ctl.observe(0, 64, 13) == []     # full window burns it
+        assert ctl._hold_windows == 0
+
     def test_steps_keep_min_le_max_at_every_intermediate(self):
         """Property over every (old, new) pair in the emit range: applying
         the ordered steps one at a time never passes through a state with
@@ -215,3 +234,79 @@ def test_adaptive_retune_end_to_end_and_old_reads_survive():
             # the retune only moved where NEW cuts land
             assert c.read("/adaptive/old-geometry") == old_data
             assert c.read("/adaptive/new-geometry") == new_data
+
+
+def test_retune_guard_rolls_back_regressing_geometry():
+    """ISSUE 17 leg c: a retune whose post-change flight window regresses
+    a blast-radius gauge (write_p95_ms here) is auto-reverted through the
+    same reconfigure path, the rollback is booked on retune_rollbacks,
+    and the controller holds before re-proposing."""
+    from hdrf_tpu.testing.minicluster import MiniCluster
+
+    overrides = {"cdc_adaptive": True, "cdc_target_mask_bits": 13}
+    with MiniCluster(n_datanodes=1, replication=1,
+                     reduction_overrides=overrides) as mc:
+        dn = mc.datanodes[0]
+        ctl = dn._cdc_controller
+        assert ctl is not None
+        dn._cdc_controller = None        # park the heartbeat tick
+        cdc = dn.reduction_ctx.config.cdc
+        mb0 = cdc.mask_bits
+        for key, value in ctl.steps(mb0, mb0 + 1):   # the retune lands
+            assert dn.reconfigure(key, value)["ok"]
+        assert cdc.mask_bits == mb0 + 1
+        # deterministic flight history: healthy baseline, then a post-
+        # retune window with write p95 tripled (ring injection keeps the
+        # guard's inputs exact; sample cadence is never the semantics)
+        dn.flight._ring.clear()
+        dn.flight._ring.extend(
+            {"t": float(i), "mono": float(i), "write_p95_ms": 10.0}
+            for i in range(4))
+        dn._arm_cdc_guard(mb0, mb0 + 1)
+        assert dn._cdc_guard is not None
+        dn.flight._ring.extend(
+            {"t": float(10 + i), "mono": float(10 + i),
+             "write_p95_ms": 30.0}
+            for i in range(dn.GUARD_MIN_SAMPLES))
+        before = int(accounting.snapshot()["counters"]
+                     .get("retune_rollbacks", 0))
+        dn._cdc_guard_tick(ctl)
+        assert dn._cdc_guard is None                 # guard consumed
+        assert cdc.mask_bits == mb0                  # geometry reverted
+        assert (cdc.min_chunk, cdc.max_chunk) == ctl.geometry(mb0)
+        assert int(accounting.snapshot()["counters"]
+                   ["retune_rollbacks"]) == before + 1
+        assert ctl._hold_windows > 0                 # sits out re-propose
+
+
+def test_retune_guard_keeps_healthy_geometry():
+    """The mirror case: post-retune samples no worse than baseline leave
+    the new geometry in place and book no rollback."""
+    from hdrf_tpu.testing.minicluster import MiniCluster
+
+    overrides = {"cdc_adaptive": True, "cdc_target_mask_bits": 13}
+    with MiniCluster(n_datanodes=1, replication=1,
+                     reduction_overrides=overrides) as mc:
+        dn = mc.datanodes[0]
+        ctl = dn._cdc_controller
+        dn._cdc_controller = None
+        cdc = dn.reduction_ctx.config.cdc
+        mb0 = cdc.mask_bits
+        for key, value in ctl.steps(mb0, mb0 + 1):
+            assert dn.reconfigure(key, value)["ok"]
+        dn.flight._ring.clear()
+        dn.flight._ring.extend(
+            {"t": float(i), "mono": float(i), "write_p95_ms": 10.0}
+            for i in range(4))
+        dn._arm_cdc_guard(mb0, mb0 + 1)
+        dn.flight._ring.extend(
+            {"t": float(10 + i), "mono": float(10 + i),
+             "write_p95_ms": 10.0}
+            for i in range(dn.GUARD_MIN_SAMPLES))
+        before = int(accounting.snapshot()["counters"]
+                     .get("retune_rollbacks", 0))
+        dn._cdc_guard_tick(ctl)
+        assert dn._cdc_guard is None
+        assert cdc.mask_bits == mb0 + 1              # retune survives
+        assert int(accounting.snapshot()["counters"]
+                   .get("retune_rollbacks", 0)) == before

@@ -1,0 +1,288 @@
+"""Crash-safety and series math for the long-horizon flight plane.
+
+Covers utils/flight_archive.py (ISSUE 17 tentpole a/b): JSONL segment
+append/rotation/GC with the chunk-index WAL's torn-tail discipline
+(index/chunk_index.py:19-27, utils/wal.py:29-60), restart-surviving
+recorder rings (utils/flight_recorder.py:41-86), and the cluster-merge
+quantile/sum/mean semantics plus step rollups the gateway's
+``/timeseries?scope=cluster`` endpoint rides (server/http_gateway.py
+timeseries)."""
+
+import json
+import os
+
+from hdrf_tpu.utils import flight_archive, metrics
+from hdrf_tpu.utils.flight_archive import FlightArchive
+from hdrf_tpu.utils.flight_recorder import FlightRecorder
+
+
+def _mk(tmp_path, **kw) -> FlightArchive:
+    return FlightArchive(str(tmp_path / "arch"), **kw)
+
+
+def _samples(n, start=0):
+    return [{"t": float(start + i), "mono": float(start + i), "g": float(i)}
+            for i in range(n)]
+
+
+# ------------------------------------------------------------- segments
+
+
+class TestArchiveSegments:
+    def test_append_replay_bit_identical(self, tmp_path):
+        arch = _mk(tmp_path)
+        samples = _samples(10)
+        for s in samples:
+            arch.append(s)
+        assert arch.replay() == samples  # bit-identical, oldest first
+        arch.close()
+
+    def test_rotation_seals_and_opens_next_segment(self, tmp_path):
+        arch = _mk(tmp_path, segment_bytes=128)
+        for s in _samples(20):
+            arch.append(s)
+        segs = flight_archive.list_segments(arch.directory)
+        assert len(segs) > 1
+        assert segs == sorted(segs)  # zero-padded seq sorts oldest first
+        assert arch.replay() == _samples(20)  # rotation loses nothing
+        arch.close()
+
+    def test_scan_lines_good_prefix(self):
+        good = b'{"a": 1}\n{"b": 2}\n'
+        docs, n = flight_archive.scan_lines(good)
+        assert docs == [{"a": 1}, {"b": 2}] and n == len(good)
+        # torn tail: final line has no newline -> dropped
+        docs, n = flight_archive.scan_lines(good + b'{"c": ')
+        assert docs == [{"a": 1}, {"b": 2}] and n == len(good)
+        # corrupt middle line stops the scan at the good prefix
+        docs, n = flight_archive.scan_lines(b'{"a": 1}\nBOOM\n{"c": 3}\n')
+        assert docs == [{"a": 1}] and n == len(b'{"a": 1}\n')
+
+    def test_torn_tail_dropped_on_replay(self, tmp_path):
+        """Kill mid-append: the half-written final line must vanish from
+        replay while every earlier sample survives byte-identical."""
+        arch = _mk(tmp_path)
+        samples = _samples(5)
+        for s in samples:
+            arch.append(s)
+        arch.close()
+        path = os.path.join(arch.directory,
+                            flight_archive.list_segments(arch.directory)[-1])
+        with open(path, "ab") as f:       # simulated torn append
+            f.write(b'{"t": 99.0, "mono":')
+        reg = metrics.registry("flight_archive")
+        before = reg.counter("torn_tail_drops")
+        assert flight_archive.replay_dir(arch.directory) == samples
+        assert reg.counter("torn_tail_drops") == before + 1
+
+    def test_reopen_truncates_torn_tail_before_appending(self, tmp_path):
+        """wal.recover discipline: post-crash appends must not land
+        behind garbage, so opening for append truncates the torn tail."""
+        arch = _mk(tmp_path)
+        for s in _samples(3):
+            arch.append(s)
+        arch.close()
+        seg = os.path.join(arch.directory,
+                           flight_archive.list_segments(arch.directory)[-1])
+        with open(seg, "ab") as f:
+            f.write(b'{"torn": ')
+        arch2 = FlightArchive(arch.directory)
+        arch2.append({"t": 3.0, "mono": 3.0, "g": 3.0})
+        assert arch2.replay() == _samples(3) + [
+            {"t": 3.0, "mono": 3.0, "g": 3.0}]
+        with open(seg, "rb") as f:
+            assert b"torn" not in f.read()  # physically truncated
+        arch2.close()
+
+    def test_gc_respects_byte_budget_never_deletes_active(self, tmp_path):
+        arch = _mk(tmp_path, segment_bytes=256, max_bytes=1024)
+        for s in _samples(200):
+            arch.append(s)
+        total = arch.total_bytes()
+        segs = flight_archive.list_segments(arch.directory)
+        # budget holds (modulo the one segment that crossed the line
+        # right before its seal-triggered GC pass)
+        assert total <= 1024 + 256
+        active = f"flight-{arch._seq:08d}.jsonl"
+        assert active in segs              # the active tail always survives
+        reg = metrics.registry("flight_archive")
+        assert reg.counter("segments_gc") > 0
+        # replay returns the SUFFIX of history: newest samples intact
+        replayed = arch.replay()
+        assert replayed and replayed[-1] == {"t": 199.0, "mono": 199.0,
+                                             "g": 199.0}
+        arch.close()
+
+    def test_gc_age_bound(self, tmp_path):
+        clock = [1000.0]
+        arch = FlightArchive(str(tmp_path / "aged"), segment_bytes=64,
+                             max_age_s=10.0, wall=lambda: clock[0])
+        for s in _samples(8):
+            arch.append(s)
+        n_before = len(flight_archive.list_segments(arch.directory))
+        assert n_before > 1
+        clock[0] += 10_000.0
+        # mtimes are real wall time; age the files on disk to match
+        for name in flight_archive.list_segments(arch.directory):
+            p = os.path.join(arch.directory, name)
+            os.utime(p, (1.0, 1.0))
+        arch.gc()
+        left = flight_archive.list_segments(arch.directory)
+        assert len(left) == 1              # only the active segment remains
+        arch.close()
+
+    def test_replay_since_and_limit(self, tmp_path):
+        arch = _mk(tmp_path)
+        for s in _samples(10):
+            arch.append(s)
+        assert [s["t"] for s in arch.replay(since=7.0)] == [7.0, 8.0, 9.0]
+        assert [s["t"] for s in arch.replay(limit=2)] == [8.0, 9.0]
+        arch.close()
+
+
+# ----------------------------------------------------- recorder + archive
+
+
+class TestRecorderArchive:
+    def test_samples_survive_restart_bit_identical(self, tmp_path):
+        """The restart-survival acceptance bar: a new recorder over the
+        same archive dir re-seeds its ring with the pre-crash samples,
+        byte-for-byte."""
+        d = str(tmp_path / "fr")
+        ticks = iter(range(100))
+        arch = FlightArchive(d)
+        fr = FlightRecorder("t-fa", lambda: {"v": 1.0}, capacity=8,
+                            clock=lambda: float(next(ticks)),
+                            wall=lambda: 500.0, archive=arch)
+        for _ in range(5):
+            fr.sample_once()
+        pre = fr.snapshot()["samples"]
+        arch.close()                       # daemon dies
+        arch2 = FlightArchive(d)
+        fr2 = FlightRecorder("t-fa", lambda: {"v": 1.0}, capacity=8,
+                             clock=lambda: 0.0, wall=lambda: 0.0,
+                             archive=arch2)
+        assert fr2.snapshot()["samples"] == pre
+        arch2.close()
+
+    def test_ring_seed_respects_capacity(self, tmp_path):
+        d = str(tmp_path / "cap")
+        arch = FlightArchive(d)
+        for s in _samples(50):
+            arch.append(s)
+        arch.close()
+        arch2 = FlightArchive(d)
+        fr = FlightRecorder("t-fa-cap", lambda: {}, capacity=4,
+                            clock=lambda: 0.0, wall=lambda: 0.0,
+                            archive=arch2)
+        ring = fr.snapshot()["samples"]
+        assert len(ring) == 4 and ring[-1]["g"] == 49.0  # newest tail
+        arch2.close()
+
+    def test_archive_append_failure_never_kills_sampling(self, tmp_path):
+        arch = _mk(tmp_path)
+        fr = FlightRecorder("t-fa-err", lambda: {"v": 1.0}, capacity=4,
+                            clock=lambda: 0.0, wall=lambda: 0.0,
+                            archive=arch)
+        arch.close()                       # appends now raise ValueError/OSError
+        reg = metrics.registry("flight_recorder")
+        before = reg.counter("archive_errors")
+        fr.sample_once()                   # must not raise
+        assert reg.counter("archive_errors") == before + 1
+        assert len(fr.snapshot()["samples"]) == 1  # ring still works
+
+
+# ------------------------------------------------------- cluster merging
+
+
+class TestClusterSeriesMath:
+    def test_merge_value_semantics(self):
+        # quantile-class gauges: MAX across nodes (cannot average p95s)
+        assert flight_archive.merge_value("read_p95_ms",
+                                          [5.0, 20.0, 10.0]) == 20.0
+        # per-node tallies: SUM
+        assert flight_archive.merge_value("blocks", [3.0, 4.0]) == 7.0
+        assert flight_archive.merge_value("garbage_bytes",
+                                          [100.0, 50.0]) == 150.0
+        # everything else (ratios): MEAN
+        assert flight_archive.merge_value("storage_ratio",
+                                          [1.0, 3.0]) == 2.0
+
+    def test_filter_series_metric_and_since(self):
+        s = [{"t": 1.0, "mono": 1.0, "a": 1.0, "b": 2.0},
+             {"t": 5.0, "mono": 5.0, "a": 3.0, "b": 4.0}]
+        out = flight_archive.filter_series(s, metric="a")
+        assert out == [{"t": 1.0, "mono": 1.0, "a": 1.0},
+                       {"t": 5.0, "mono": 5.0, "a": 3.0}]
+        assert flight_archive.filter_series(s, since=2.0) == [s[1]]
+        out = flight_archive.filter_series(s, metric="a,b", since=2.0)
+        assert out == [s[1]]
+
+    def test_merge_cluster_quantiles_on_injected_clocks(self):
+        """The acceptance-criteria math check: two DNs + the NN aligned
+        into 1 s buckets; p95 merges as MAX, blocks SUM, ratios MEAN."""
+        dn1 = [{"t": 10.2, "read_p95_ms": 5.0, "blocks": 3,
+                "storage_ratio": 1.0},
+               {"t": 11.1, "read_p95_ms": 6.0, "blocks": 3,
+                "storage_ratio": 1.0}]
+        dn2 = [{"t": 10.7, "read_p95_ms": 50.0, "blocks": 4,
+                "storage_ratio": 3.0}]
+        nn = [{"t": 10.4, "datanodes_live": 2}]
+        merged = flight_archive.merge_cluster(
+            [("dn-1", dn1), ("dn-2", dn2), ("namenode", nn)], step_s=1.0)
+        assert [m["t"] for m in merged] == [10.0, 11.0]
+        b0 = merged[0]
+        assert b0["nodes"] == 3
+        assert b0["read_p95_ms"] == 50.0          # slowest node's tail
+        assert b0["blocks"] == 7.0                # summed tally
+        assert b0["storage_ratio"] == 2.0         # mean ratio
+        assert b0["datanodes_live"] == 2.0
+        b1 = merged[1]
+        assert b1["nodes"] == 1 and b1["read_p95_ms"] == 6.0
+
+    def test_rollup_min_max_mean_last(self):
+        s = [{"t": 0.0, "g": 1.0}, {"t": 1.0, "g": 3.0},
+             {"t": 2.0, "g": 2.0}, {"t": 10.0, "g": 7.0}]
+        rows = flight_archive.rollup(s, step_s=5.0)
+        assert len(rows) == 2
+        r0 = rows[0]
+        assert r0["t"] == 0.0 and r0["n"] == 3
+        assert r0["gauges"]["g"] == {"min": 1.0, "max": 3.0,
+                                     "mean": 2.0, "last": 2.0}
+        assert rows[1]["gauges"]["g"]["last"] == 7.0
+
+    def test_rollup_bounds_response(self):
+        """A long archive renders bounded: the rollup row count tracks
+        the time span / step, not the sample count."""
+        s = [{"t": float(i), "g": float(i)} for i in range(10_000)]
+        rows = flight_archive.rollup(s, step_s=1000.0)
+        assert len(rows) == 10
+
+    def test_query_merges_ring_and_archive_dedup(self, tmp_path):
+        arch = _mk(tmp_path)
+        old = {"t": 1.0, "mono": 1.0, "g": 0.0}
+        arch.append(old)                   # pre-restart history
+        ticks = iter(range(10, 20))
+        fr = FlightRecorder("t-q", lambda: {"g": 1.0}, capacity=4,
+                            clock=lambda: float(next(ticks)),
+                            wall=lambda: 2.0, archive=arch)
+        # the archive seeded the ring with `old`; new samples land in both
+        fr.sample_once()
+        out = flight_archive.query(fr, arch)
+        assert out["daemon"] == "t-q" and out["archived"] == 2
+        assert out["samples"] == [old, {"t": 2.0, "mono": 10.0, "g": 1.0}]
+        # metric/since filters + tail limit apply after the merge
+        out = flight_archive.query(fr, arch, metric="g", since=2.0)
+        assert out["samples"] == [{"t": 2.0, "mono": 10.0, "g": 1.0}]
+        out = flight_archive.query(fr, arch, limit=1)
+        assert len(out["samples"]) == 1
+        arch.close()
+
+    def test_query_samples_are_json_plain(self, tmp_path):
+        arch = _mk(tmp_path)
+        fr = FlightRecorder("t-qj", lambda: {"g": 1.0}, capacity=2,
+                            clock=lambda: 0.0, wall=lambda: 0.0,
+                            archive=arch)
+        fr.sample_once()
+        json.dumps(flight_archive.query(fr, arch))  # endpoint body
+        arch.close()

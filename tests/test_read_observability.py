@@ -457,6 +457,106 @@ class TestSloReport:
                 "read_p95_ms"} <= gauges
 
 
+class TestSloTrend:
+    # injected step regression in read_p95_ms; cache_hit_ratio flat
+    SAMPLES = [{"t": float(i + 1), "mono": float(i + 1),
+                "read_p95_ms": 10.0 if i < 4 else 20.0,
+                "cache_hit_ratio": 0.8} for i in range(8)]
+
+    def test_trend_flags_injected_regression_deterministically(self):
+        tr = slo_report.trend(self.SAMPLES)
+        assert tr["regressions"] == ["read_p95_ms"]
+        assert tr["verdict"] == "REGRESSED"
+        rows = {r["metric"]: r for r in tr["metrics"]}
+        r = rows["read_p95_ms"]
+        assert r["slope"] == pytest.approx(80.0 / 42.0)
+        assert r["changepoint"]["index"] == 4
+        assert r["changepoint"]["before"] == pytest.approx(10.0)
+        assert r["changepoint"]["after"] == pytest.approx(20.0)
+        assert rows["cache_hit_ratio"]["regressed"] is False
+
+    def test_flat_series_never_flags(self):
+        flat = [{"read_p95_ms": 10.0, "cache_hit_ratio": 0.8}
+                for _ in range(8)]
+        tr = slo_report.trend(flat)
+        assert tr["regressions"] == [] and tr["verdict"] == "OK"
+
+    def test_slow_ramp_caught_by_slope_not_changepoint(self):
+        """A creep with no step still regresses: the fitted total drift
+        clears the jitter floor even though no single shift does."""
+        ramp = [{"write_p95_ms": 10.0 + i} for i in range(10)]
+        tr = slo_report.trend(ramp)
+        assert tr["regressions"] == ["write_p95_ms"]
+
+    def test_down_direction_metric(self):
+        falling = [{"cache_hit_ratio": 0.8 if i < 4 else 0.2}
+                   for i in range(8)]
+        tr = slo_report.trend(falling)
+        assert tr["regressions"] == ["cache_hit_ratio"]
+
+    def test_format_trend_table_golden(self):
+        golden = (
+            "slo trend: 8 samples, jitter floor = 25%\n"
+            "verdict: REGRESSED (read_p95_ms)\n"
+            "\n"
+            "metric                            first       last"
+            "      slope   cp  flag\n"
+            "cache_hit_ratio                   0.800      0.800"
+            "     0.0000    4     -\n"
+            "read_p95_ms                      10.000     20.000"
+            "     1.9048    4  REGR")
+        assert slo_report.format_trend_table(
+            slo_report.trend(self.SAMPLES)) == golden
+
+    def test_trend_from_archive_directory(self, tmp_path, capsys):
+        """Satellite: --input accepts a flight-archive DIRECTORY and the
+        trend verdict survives a restart — the samples come back off
+        disk, torn tail and all."""
+        from hdrf_tpu.utils.flight_archive import FlightArchive
+        d = str(tmp_path / "arch")
+        arch = FlightArchive(d)
+        for s in self.SAMPLES:
+            arch.append(s)
+        arch.close()
+        seg = sorted(os.listdir(d))[-1]
+        with open(os.path.join(d, seg), "ab") as f:
+            f.write(b'{"torn": ')           # crash mid-append
+        rc = slo_report.main(["--input", d, "--trend", "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["samples"] == 8
+        assert out["regressions"] == ["read_p95_ms"]
+        # flat archived series stays unflagged through the same path
+        d2 = str(tmp_path / "flat")
+        arch2 = FlightArchive(d2)
+        for _ in range(8):
+            arch2.append({"read_p95_ms": 10.0})
+        arch2.close()
+        rc = slo_report.main(["--input", d2, "--trend", "--json"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["verdict"] == "OK"
+
+    def test_guard_direction_aware_with_blast_radius(self):
+        base = [{"read_p95_ms": 10.0, "dedup_ratio": 2.0, "noise": 1.0}
+                for _ in range(4)]
+        worse = [{"read_p95_ms": 20.0, "dedup_ratio": 2.0, "noise": 9.0}
+                 for _ in range(4)]
+        g = slo_report.guard(base, worse)
+        assert g["regressed"] is True
+        rows = {r["metric"]: r for r in g["rows"]}
+        assert rows["read_p95_ms"]["regressed"] is True
+        assert rows["noise"]["regressed"] is False  # unknown direction
+        # narrowing to the change's blast radius vetoes unrelated gauges
+        g = slo_report.guard(base, worse, gauges=("dedup_ratio",))
+        assert g["regressed"] is False
+        assert [r["metric"] for r in g["rows"]] == ["dedup_ratio"]
+
+    def test_guard_improvement_never_rolls_back(self):
+        base = [{"read_p95_ms": 20.0} for _ in range(4)]
+        better = [{"read_p95_ms": 10.0} for _ in range(4)]
+        assert slo_report.guard(base, better)["regressed"] is False
+
+
 # ------------------------------------------------------------ cluster e2e
 
 
@@ -557,6 +657,68 @@ class TestClusterReadObservability:
                     "under_replicated", "safemode", "tenant_count"):
             assert key in last, f"NN flight sample missing {key}"
         assert last["datanodes_live"] >= 1
+
+    def test_timeseries_metric_filter_strictly_smaller(self, ro_cluster):
+        """Satellite bar: a ?metric= filtered pull is strictly smaller
+        than the unfiltered one, on the DN status server and the gateway
+        alike (the filter runs server-side, not in the client)."""
+        mc, gw = ro_cluster
+        dn = mc.datanodes[0]
+        dn.flight.sample_once()
+        mc.namenode.flight.sample_once()
+        host, port = dn._status.addr
+        full = _get(f"http://{host}:{port}/timeseries")
+        slim = _get(f"http://{host}:{port}/timeseries"
+                    f"?metric=storage_ratio")
+        assert len(slim) < len(full)
+        doc = json.loads(slim)
+        assert doc["samples"]
+        assert set(doc["samples"][-1]) == {"t", "mono", "storage_ratio"}
+        gfull = _get(f"http://{gw.addr[0]}:{gw.addr[1]}/timeseries")
+        gslim = _get(f"http://{gw.addr[0]}:{gw.addr[1]}/timeseries"
+                     f"?metric=blocks")
+        assert len(gslim) < len(gfull)
+        # ?since= far in the future empties the series but keeps the shell
+        doc = json.loads(_get(f"http://{host}:{port}/timeseries"
+                              f"?since=9e18"))
+        assert doc["samples"] == [] and doc["daemon"] == dn.dn_id
+
+    def test_gateway_cluster_scope_merges_all_daemons(self, ro_cluster):
+        """?scope=cluster fans out to every live DN over the
+        flight_timeseries DT op, merges with the NN series, and a &step=
+        rollup bounds the response."""
+        mc, gw = ro_cluster
+        mc.datanodes[0].flight.sample_once()
+        mc.namenode.flight.sample_once()
+        doc = json.loads(_get(f"http://{gw.addr[0]}:{gw.addr[1]}"
+                              f"/timeseries?scope=cluster"))
+        assert doc["scope"] == "cluster"
+        assert "namenode" in doc["daemons"]
+        assert any(d != "namenode" for d in doc["daemons"])
+        assert doc["samples"]
+        merged = doc["samples"][-1]
+        assert merged["nodes"] >= 1 and "t" in merged
+        # DN gauges and NN gauges land in one merged series
+        names = set().union(*(set(s) for s in doc["samples"]))
+        assert "storage_ratio" in names and "datanodes_live" in names
+        rolled = json.loads(_get(f"http://{gw.addr[0]}:{gw.addr[1]}"
+                                 f"/timeseries?scope=cluster&step=60"))
+        assert rolled["rollup"]
+        row = rolled["rollup"][-1]
+        assert {"min", "max", "mean", "last"} <= set(
+            next(iter(row["gauges"].values())))
+
+    def test_nn_rpc_latency_histogram_and_p99_gauge(self, ro_cluster):
+        """Satellite: every NN RPC books nn_rpc_us|method=<name> and the
+        NN flight sample carries the rolling p99 gauge."""
+        mc, _ = ro_cluster
+        with mc.client("t-ro-rpc") as c:
+            c.ls("/")
+        hists = metrics.registry("rpc.namenode").snapshot()["histograms"]
+        assert hists["nn_rpc_us|method=listing"]["count"] >= 1
+        sample = mc.namenode.flight.sample_once()
+        assert "nn_rpc_p99_ms" in sample
+        assert sample["nn_rpc_p99_ms"] > 0.0
 
     def test_read_smoke_mostly_attributed(self, ro_cluster):
         """Acceptance bar: >= 95% of the read smoke's serve wall clock is

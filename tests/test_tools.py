@@ -219,6 +219,50 @@ class TestParityCitations:
             problems = check_parity.check_bench_contract(root, key=key)
             assert not problems, "\n".join(problems)
 
+    def test_bench_longhorizon_block_in_both_json_branches(self):
+        """Long-horizon flight-plane bench contract (ISSUE 17): the
+        "longhorizon" block — and its storage_ratio_slope churn-curve
+        key from _longhorizon_summary — must be a literal key in BOTH
+        json.dumps branches of bench.py."""
+        import hdrf_tpu
+        from hdrf_tpu.tools import check_parity
+
+        root = os.path.dirname(os.path.abspath(hdrf_tpu.__file__))
+        for key in ("longhorizon", "longhorizon.storage_ratio_slope"):
+            problems = check_parity.check_bench_contract(root, key=key)
+            assert not problems, "\n".join(problems)
+
+
+class TestChurnHarness:
+    def test_churn_one_json_line_with_curves(self):
+        """`benchmarks churn` contract (ISSUE 17): EXACTLY one JSON line
+        carrying per-round flight samples, the four SLO curves with
+        first/last/slope, and the trend verdict.  Tiny run — deletes
+        against sealed containers must push the final storage_ratio
+        ABOVE the first round's (the physical bytes stay, the logical
+        shrink: that's the regression the curve exists to show)."""
+        from hdrf_tpu import benchmarks
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert benchmarks.main(
+                ["churn", "--rounds", "3", "--files", "3", "--kb", "8",
+                 "--delete-frac", "0.5"]) == 0
+        lines = buf.getvalue().splitlines()
+        assert len(lines) == 1
+        o = json.loads(lines[0])
+        assert o["op"].startswith("churn")
+        assert o["rounds"] == 3 and o["samples"] == 3
+        for name in ("storage_ratio", "garbage_bytes",
+                     "chunk_cache_hit_ratio", "read_p95_ms"):
+            curve = o["curves"][name]
+            assert {"first", "last", "slope", "series"} <= set(curve)
+            assert len(curve["series"]) == 3
+        sr = o["curves"]["storage_ratio"]
+        assert sr["last"] > sr["first"]  # deletes inflate the ratio
+        assert "storage_ratio" in o["regressions"]
+        assert o["verdict"] == "REGRESSED"
+
 
 class TestOfflineViewers:
     def test_oiv_oev(self, cluster, tmp_path):
