@@ -764,6 +764,57 @@ def _longhorizon_summary() -> dict:
     }
 
 
+def _nn_summary() -> dict:
+    """Control-plane stamp for the JSON line: the ``benchmarks nn``
+    metadata-storm harness (concurrent wire clients against a started
+    NameNode — the load shape that populates the per-method RPC
+    decomposition and the instrumented namesystem lock's books,
+    hdrf_tpu/benchmarks.py bench_nn) run in a CHILD process on the clean
+    CPU env — the storm boots its own NN and must not share the parent's
+    possibly-TPU-held backend.  Folded to the contention-observatory keys
+    (rpc_p99_ms, lock_saturation, lock_wait_p99_us, top_method) that
+    ROADMAP item 2's observer-read/sharded-lock PR will read as its
+    before/after baseline; any failure degrades to ``{"ok": False}`` so
+    a storm regression can never take down the bench line itself."""
+    import subprocess
+
+    from hdrf_tpu.utils.cleanenv import clean_cpu_env
+
+    smoke = os.environ.get("HDRF_BENCH_SMOKE") == "1"
+    argv = [sys.executable, "-m", "hdrf_tpu.benchmarks", "nn"]
+    argv += (["--ops", "80", "--clients", "4", "--meta-per-op", "2"]
+             if smoke else ["--ops", "1500", "--clients", "8"])
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=600,
+            env=clean_cpu_env(8),
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = proc.stdout.strip().splitlines()[-1]
+        out = json.loads(line)
+    except Exception as e:          # noqa: BLE001 — stamp must never raise
+        return {"ok": False, "error": repr(e)[:200], "rpc_p99_ms": 0.0,
+                "lock_saturation": 0.0, "lock_wait_p99_us": 0.0,
+                "top_method": None}
+    if proc.returncode != 0:
+        return {"ok": False, "error": proc.stderr.strip()[-200:],
+                "rpc_p99_ms": 0.0, "lock_saturation": 0.0,
+                "lock_wait_p99_us": 0.0, "top_method": None}
+    return {
+        # the observatory's own health bar: every profiled RPC's service
+        # time >= 95% attributed to named phases, and a clean storm
+        "ok": bool(out.get("attributed_frac", 0.0) >= 0.95
+                   and out.get("errors", 1) == 0),
+        "clients": out.get("clients", 0),
+        "ops_per_s": out.get("ops_per_s", 0),
+        "rpc_p99_ms": out.get("rpc_p99_ms", 0.0),
+        "lock_saturation": out.get("lock_saturation", 0.0),
+        "lock_wait_p99_us": out.get("lock_wait_p99_us", 0.0),
+        "top_method": out.get("top_method"),
+        "lock_share": out.get("lock_share", {}),
+        "attributed_frac": out.get("attributed_frac", 0.0),
+    }
+
+
 def _phase_profile(t0: float, t1: float) -> dict:
     """Cross-thread overlap profile of [t0, t1] for the JSON line: wall
     partitioned into the profiler's exclusive classes (host/device busy,
@@ -856,6 +907,7 @@ def main() -> None:
                 "pipeline": _pipeline_summary(phase_profile),
                 "multichip": _multichip_summary(),
                 "longhorizon": _longhorizon_summary(),
+                "nn": _nn_summary(),
             }))
             return
 
@@ -1190,6 +1242,7 @@ def main() -> None:
             "pipeline": _pipeline_summary(phase_profile),
             "multichip": _multichip_summary(),
             "longhorizon": _longhorizon_summary(),
+            "nn": _nn_summary(),
         }))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -2,8 +2,13 @@
 
 Analogs of the reference's test-tree benchmarks:
 
-- ``nn``   — metadata op throughput against an in-process NameNode
-             (NNThroughputBenchmark.java:97 — single-process, no RPC).
+- ``nn``   — metadata-storm harness: concurrent wire clients hammer
+             create/stat/getBlockLocations/listing against a started
+             NameNode; ONE JSON line with rpc_p99_ms, lock_saturation
+             and the per-method lock-share curve (what
+             NNThroughputBenchmark.java:97 never measured — it calls
+             handlers in-process, so lock contention and RPC service
+             time are invisible by construction).
 - ``dfs``  — DFS write/read MB/s through a MiniCluster per reduction scheme
              (BenchmarkThroughput.java).
 - ``ec``   — RS encode/decode MB/s + striped write/read MB/s
@@ -34,59 +39,95 @@ def _rate(n: int, t0: float) -> float:
 
 
 def bench_nn(args) -> None:
+    """Metadata-storm harness (ISSUE 18; the NNThroughputBenchmark.java:97
+    successor): ``--clients`` concurrent WIRE clients each run a data op
+    (create + addBlock + complete — the edit-log group-commit load shape)
+    followed by ``--meta-per-op`` read-plane calls (stat /
+    getBlockLocations / listing, round-robin), against a started NameNode
+    over real RPC connections so the per-method service-time
+    decomposition, the lock books and the handler-pool gauges all
+    populate.  Prints exactly ONE JSON line: throughput, rolling
+    ``rpc_p99_ms``, ``lock_saturation``, the rolling lock-wait p99, the
+    top lock-holding method and the per-method lock-share curve."""
     import tempfile
+    import threading
 
     from hdrf_tpu.config import NameNodeConfig
+    from hdrf_tpu.proto.rpc import RpcClient
     from hdrf_tpu.server.namenode import NameNode
 
     with tempfile.TemporaryDirectory() as d:
-        nn = NameNode(NameNodeConfig(meta_dir=d, replication=1))
-        nn.rpc_register_datanode("dn-bench", ["127.0.0.1", 1])
-        n = args.ops
-        t0 = time.perf_counter()
-        for i in range(n):
-            nn.rpc_mkdir(f"/bench/dir{i % 100}/sub{i}")
-        print(json.dumps({"op": "mkdir", "ops_per_s": round(_rate(n, t0))}))
-        # Create chains from CONCURRENT clients — the NameNode's real load
-        # shape, and what the edit log's group commit batches: handlers
-        # buffer under the namesystem lock and one fsync covers every
-        # concurrent handler's records (FSEditLog.logSync design).
-        import threading
+        nn = NameNode(NameNodeConfig(
+            meta_dir=d, replication=1,
+            heartbeat_interval_s=30.0, dead_node_interval_s=600.0)).start()
+        try:
+            nn.rpc_register_datanode("dn-bench", ["127.0.0.1", 1])
+            clients = max(1, args.clients)
+            per = max(1, args.ops // clients)
+            meta = max(0, args.meta_per_op)
+            errors = [0] * clients
+            calls = [0] * clients
 
-        workers = 16
-        per = n // workers
+            def storm(w: int) -> None:
+                with RpcClient(nn.addr) as c:
+                    for i in range(per):
+                        # rotate subdirs so listings stay <= --files wide
+                        p = f"/storm/c{w}/{i // args.files}/f{i}"
+                        try:
+                            c.call("create", path=p, client=f"s{w}")
+                            alloc = c.call("add_block", path=p,
+                                           client=f"s{w}")
+                            c.call("complete", path=p, client=f"s{w}",
+                                   block_lengths={alloc["block_id"]: 1024})
+                            calls[w] += 3
+                            for j in range(meta):
+                                which = (i * meta + j) % 3
+                                if which == 0:
+                                    c.call("stat", path=p)
+                                elif which == 1:
+                                    c.call("get_block_locations", path=p)
+                                else:
+                                    c.call("listing",
+                                           path=f"/storm/c{w}/"
+                                                f"{i // args.files}")
+                                calls[w] += 1
+                            if w == 0 and i % 50 == 0:
+                                c.call("heartbeat", dn_id="dn-bench")
+                                calls[w] += 1
+                        except Exception:  # noqa: BLE001 — count, keep going
+                            errors[w] += 1
 
-        def chain(w: int) -> None:
-            for i in range(per):
-                p = f"/bench/f{w}_{i}"
-                nn.rpc_create(p, client=f"b{w}")
-                if w == 0 and i % 50 == 0:
-                    nn.rpc_heartbeat("dn-bench")  # keep the DN alive
-                alloc = nn.rpc_add_block(p, client=f"b{w}")
-                nn.rpc_complete(p, client=f"b{w}",
-                                block_lengths={alloc["block_id"]: 1024})
-        t0 = time.perf_counter()
-        ts = [threading.Thread(target=chain, args=(w,)) for w in range(workers)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-        print(json.dumps({"op": "create+addBlock+complete",
-                          "clients": workers,
-                          "ops_per_s": round(_rate(per * workers, t0))}))
-        names = [f"/bench/f{w}_{i}" for w in range(workers)
-                 for i in range(per)]
-        t0 = time.perf_counter()
-        for p in names:
-            nn.rpc_get_block_locations(p)
-        print(json.dumps({"op": "getBlockLocations",
-                          "ops_per_s": round(_rate(len(names), t0))}))
-        t0 = time.perf_counter()
-        for p in names:
-            nn.rpc_delete(p)
-        print(json.dumps({"op": "delete",
-                          "ops_per_s": round(_rate(len(names), t0))}))
-        nn._editlog.close()
+            t0 = time.perf_counter()
+            ts = [threading.Thread(target=storm, args=(w,))
+                  for w in range(clients)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            cont = nn.rpc_contention()
+            lock = cont["lock"]
+            shares = sorted(((m, r["hold_share"])
+                             for m, r in lock["by_method"].items()),
+                            key=lambda kv: kv[1], reverse=True)
+            print(json.dumps({
+                "bench": "nn_metadata_storm",
+                "clients": clients,
+                "data_ops": per * clients,
+                "meta_per_op": meta,
+                "rpc_calls": sum(calls),
+                "errors": sum(errors),
+                "ops_per_s": round(sum(calls) / dt) if dt > 0 else 0,
+                "rpc_p99_ms": round(cont["rpc_p99_ms"], 3),
+                "lock_saturation": round(lock["saturation"], 4),
+                "lock_wait_p99_us": round(
+                    lock["wait_us"].get("p99", 0.0), 1),
+                "top_method": shares[0][0] if shares else None,
+                "lock_share": {m: round(s, 4) for m, s in shares[:8]},
+                "attributed_frac": round(cont["attributed_frac"], 4),
+            }))
+        finally:
+            nn.stop()
 
 
 def _dfs_pipeline_ab(args) -> None:
@@ -1029,7 +1070,14 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="hdrf-bench")
     sub = p.add_subparsers(dest="which", required=True)
     d = sub.add_parser("nn")
-    d.add_argument("--ops", type=int, default=5000)
+    d.add_argument("--ops", type=int, default=2000,
+                   help="total data ops (create+addBlock+complete chains)")
+    d.add_argument("--clients", type=int, default=8,
+                   help="concurrent wire clients")
+    d.add_argument("--meta-per-op", type=int, default=3,
+                   help="stat/getBlockLocations/listing calls per data op")
+    d.add_argument("--files", type=int, default=100,
+                   help="files per listing directory (rotation width)")
     d.set_defaults(fn=bench_nn)
     d = sub.add_parser("dfs")
     d.add_argument("--mb", type=int, default=64)

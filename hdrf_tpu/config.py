@@ -286,6 +286,15 @@ class NameNodeConfig:
     status_port: int | None = None
     # Watchdog budget for in-flight RPCs (utils/watchdog.py).
     stall_budget_s: float = 30.0
+    # Control-plane contention observatory (utils/lockprof.py): cap on
+    # concurrent RPC handler connections — past it the accept loop parks
+    # and a metadata storm backs up into the TCP listen queue instead of
+    # spawning threads without bound (None = unbounded, the reference's
+    # thread-per-connection default) — and the instrumented namesystem
+    # lock's long-hold budget (stack captured + lockprof.long_hold fired
+    # for any hold past it; the write-lock-reporting-threshold analog).
+    rpc_max_handlers: int | None = None
+    lock_long_hold_s: float = 0.5
     # EC cold tier (storage/stripe_store.py): sealed-container striping
     # geometry (ErasureCodingPolicy RS-k-m analog, default RS(6,3)) and
     # the demotion age: a complete, fully-replicated block whose file has

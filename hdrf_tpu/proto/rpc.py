@@ -11,7 +11,19 @@ Response body: ``[req_id, 0, result]`` or ``[req_id, 1, {"error", "message"}]``
 — errors round-trip as :class:`RpcError` (the IPC RemoteException analog).
 
 Server threading model is thread-per-connection, mirroring the reference's
-thread-per-DataXceiver design (DataXceiverServer.java:44).
+thread-per-DataXceiver design (DataXceiverServer.java:44) — but bounded:
+``max_handlers`` caps live handler threads the way ``dfs.datanode.max.transfer
+.threads`` caps xceivers (the accept loop parks past the cap, so overload
+backs up into the TCP listen queue instead of an unbounded thread spawn).
+
+NameNode service-time decomposition (ISSUE 18): every wire request's wall
+clock is partitioned into ``frame_read`` / ``dispatch_queue`` / ``lock_wait``
+/ ``locked`` / ``serialize`` / ``reply`` phases via the write-path profiler's
+exclusive-class boundary sweep (utils/profiler.py profile_spans) — the
+decomposition the reference never had for its RPC layer (RpcMetrics.java:118
+keeps one queue-time + one processing-time average per server, never
+per-method, never lock-attributed).  Lock phases ride the ambient
+request context (utils/lockprof.py bind_request).
 """
 
 from __future__ import annotations
@@ -26,7 +38,8 @@ from typing import Any
 
 import msgpack
 
-from hdrf_tpu.utils import metrics, retry, rollwin, tenants, tracing
+from hdrf_tpu.utils import (fault_injection, lockprof, metrics, profiler,
+                            retry, rollwin, tenants, tracing)
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
@@ -82,10 +95,14 @@ class RpcServer:
     """
 
     def __init__(self, host: str, port: int, service: Any, name: str,
-                 watchdog: Any | None = None):
+                 watchdog: Any | None = None,
+                 max_handlers: int | None = None):
         """``watchdog``: optional utils.watchdog.StallWatchdog — every
         dispatched method is tracked so handler threads wedged past the
-        budget (VM write-burst stalls) surface in stall_total/stacks."""
+        budget (VM write-burst stalls) surface in stall_total/stacks.
+        ``max_handlers``: cap on live handler threads (one per connection);
+        past it the accept loop itself parks, so a metadata storm backs up
+        into the TCP listen queue instead of spawning without bound."""
         self._service = service
         self._name = name
         self._metrics = metrics.registry(f"rpc.{name}")
@@ -97,6 +114,19 @@ class RpcServer:
         # plane has no RPC server of its own worth the extra books.
         self._lat_win = (rollwin.RollingWindow(window_s=300.0, maxlen=512)
                         if name == "namenode" else None)
+        # Cumulative phase-attribution accountant (NN only): how much of
+        # the dispatched wall clock the named phases explain — the >= 95%
+        # contention-observatory acceptance bar, cheap enough to keep
+        # always-on (two float adds per request).
+        self._attr_lock = threading.Lock()
+        self._attr_wall_s = 0.0
+        self._attr_used_s = 0.0
+        self.max_handlers = max_handlers
+        self._handler_sem = (threading.BoundedSemaphore(max_handlers)
+                             if max_handlers else None)
+        self._count_lock = threading.Lock()
+        self._handler_threads = 0
+        self._inflight = 0
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -106,8 +136,7 @@ class RpcServer:
                 outer._conns.add(sock)
                 try:
                     while True:
-                        req = recv_frame(sock)
-                        send_frame(sock, outer._dispatch(req))
+                        outer._serve_one(sock)
                 except (ConnectionError, OSError):
                     return
                 finally:
@@ -117,11 +146,40 @@ class RpcServer:
             daemon_threads = True
             allow_reuse_address = True
 
+            def process_request(self, request, client_address):
+                # Accept-loop backpressure: a full handler pool parks the
+                # acceptor HERE, before the thread spawn — new connections
+                # queue in the kernel listen backlog (the xceiver-cap
+                # refusal analog, soft form).
+                if outer._handler_sem is not None:
+                    outer._handler_sem.acquire()
+                super().process_request(request, client_address)
+
+            def process_request_thread(self, request, client_address):
+                outer._note_handler(+1)
+                try:
+                    super().process_request_thread(request, client_address)
+                finally:
+                    outer._note_handler(-1)
+                    if outer._handler_sem is not None:
+                        outer._handler_sem.release()
+
         self._server = Server((host, port), Handler)
         self._conns: set[socket.socket] = set()
         self._thread: threading.Thread | None = None
         self._retry_cache: dict[str, tuple[float, list]] = {}
         self._retry_lock = threading.Lock()
+
+    def _note_handler(self, delta: int) -> None:
+        with self._count_lock:
+            self._handler_threads += delta
+            self._metrics.gauge("rpc_handler_threads",
+                                float(self._handler_threads))
+
+    def _note_inflight(self, delta: int) -> None:
+        with self._count_lock:
+            self._inflight += delta
+            self._metrics.gauge("rpc_inflight", float(self._inflight))
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -135,8 +193,97 @@ class RpcServer:
         q = self._lat_win.quantiles((99,))
         return (q or {}).get("p99", 0.0) / 1e3
 
-    def _dispatch(self, req: list) -> list:
+    def _serve_one(self, sock: socket.socket) -> None:
+        """One request/response cycle with service-time decomposition.
+
+        The block on the 4-byte length header happens OUTSIDE the profiled
+        window — a keep-alive connection parked between calls is idle, not
+        service time.  From the header's arrival on, every segment lands as
+        a span: body read (``frame_read``), side-channel/auth/cache work
+        (``dispatch_queue``), the handler (``handler``, refined by the
+        instrumented lock's ``lock_wait``/``locked``), response pack
+        (``serialize``) and the write back (``reply``)."""
+        hdr = recv_exact(sock, _LEN.size)
+        t0 = time.perf_counter()
+        (n,) = _LEN.unpack(hdr)
+        if n > MAX_FRAME:
+            raise ConnectionError(f"oversized frame: {n}")
+        body = recv_exact(sock, n)
+        spans: list[tuple] = [("frame_read", t0, time.perf_counter())]
+        req = msgpack.unpackb(body, raw=False, use_list=True,
+                              strict_map_key=False)
+        self._note_inflight(+1)
+        try:
+            resp = self._dispatch(req, spans=spans)
+        finally:
+            self._note_inflight(-1)
+        t_ser0 = time.perf_counter()
+        payload = msgpack.packb(resp)
+        if len(payload) > MAX_FRAME:
+            raise ValueError(f"frame too large: {len(payload)}")
+        t_ser1 = time.perf_counter()
+        spans.append(("serialize", t_ser0, t_ser1))
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+        t1 = time.perf_counter()
+        spans.append(("reply", t_ser1, t1))
+        if self._lat_win is not None and isinstance(req, list) and len(req) == 3:
+            self._profile_request(str(req[1]), spans, t0, t1)
+
+    def _profile_request(self, method: str, spans: list, t0: float,
+                         t1: float) -> None:
+        """Exclusive-phase partition of one request's service time
+        (profiler.profile_spans — same sweep as the DN block timelines),
+        observed as ``nn_rpc_phase_us|method=,phase=`` histograms plus the
+        cumulative attributed-fraction accountant."""
+        prof = profiler.profile_spans(spans, t0, t1)
+        for name, s in prof["phases"].items():
+            self._metrics.observe(f"nn_rpc_phase_us|method={method},"
+                                  f"phase={name}", s * 1e6)
+        with self._attr_lock:
+            self._attr_wall_s += prof["wall_s"]
+            self._attr_used_s += prof["wall_s"] * prof["attributed_frac"]
+
+    def attributed_frac(self) -> float:
+        """Cumulative share of dispatched wall clock explained by named
+        phases (1.0 before any wire request — nothing unattributed yet)."""
+        with self._attr_lock:
+            return (self._attr_used_s / self._attr_wall_s
+                    if self._attr_wall_s > 0 else 1.0)
+
+    def contention_summary(self) -> dict:
+        """Per-method RPC service table for ``/contention``: calls, errors,
+        p99 µs and per-phase mean µs (from the cumulative histograms), plus
+        the server-wide attribution and handler-pool gauges."""
+        snap = self._metrics.snapshot()
+        counters, hists = snap["counters"], snap["histograms"]
+        methods: dict[str, dict] = {}
+        for key, h in hists.items():
+            if not key.startswith("nn_rpc_us|method="):
+                continue
+            m = key.split("method=", 1)[1]
+            methods[m] = {"calls": counters.get(f"{m}_calls", 0),
+                          "errors": counters.get(f"{m}_errors", 0),
+                          "p99_us": h["p99"], "mean_us": h["mean"],
+                          "phase_us": {}}
+        for key, h in hists.items():
+            if not key.startswith("nn_rpc_phase_us|method="):
+                continue
+            label = key.split("method=", 1)[1]
+            m, _, phase = label.partition(",phase=")
+            if m in methods:
+                methods[m]["phase_us"][phase] = round(h["mean"], 1)
+        return {"rpc_p99_ms": self.rpc_p99_ms(),
+                "attributed_frac": self.attributed_frac(),
+                "inflight": self._inflight,
+                "handler_threads": self._handler_threads,
+                "max_handlers": self.max_handlers,
+                "methods": methods}
+
+    def _dispatch(self, req: list, spans: list | None = None) -> list:
         req_id, method, kwargs = req
+        # dispatch_queue starts where frame_read ended: side-channel
+        # parsing, auth and the retry cache all land in that phase.
+        t_in = spans[0][2] if spans else time.perf_counter()
         trace = kwargs.pop("_trace", None)
         retry_id = kwargs.pop("_retry_id", None)
         dtoken = kwargs.pop("_dtoken", None)
@@ -179,11 +326,21 @@ class RpcServer:
                 return [req_id, *cached]
         track = (self._watchdog.track(f"rpc.{method}")
                  if self._watchdog is not None else _null_ctx())
+        # Wire requests bind the ambient request context so the service's
+        # instrumented lock attributes its wait/hold to this method and
+        # lands lock_wait/locked spans in this request's decomposition;
+        # in-process calls (spans is None) skip the stamp.
+        req_ctx = (lockprof.bind_request(method, spans)
+                   if spans is not None else _null_ctx())
         t_start = time.perf_counter()
-        with retry.bind_remaining(deadline_hdr), track, \
+        if spans is not None:
+            spans.append(("dispatch_queue", t_in, t_start))
+        with retry.bind_remaining(deadline_hdr), track, req_ctx, \
                 self._tracer.span(method,
                                   parent=tuple(trace) if trace else None):
             try:
+                fault_injection.point("rpc.dispatch", server=self._name,
+                                      method=method)
                 with self._metrics.time(f"{method}_us"):
                     result = fn(**kwargs)
                 self._metrics.incr(f"{method}_calls")
@@ -191,6 +348,9 @@ class RpcServer:
             except Exception as e:  # noqa: BLE001 — errors cross the wire
                 self._metrics.incr(f"{method}_errors")
                 out = [1, {"error": type(e).__name__, "message": str(e)}]
+        t_h1 = time.perf_counter()
+        if spans is not None:
+            spans.append(("handler", t_start, t_h1))
         if self._lat_win is not None:
             dt_us = (time.perf_counter() - t_start) * 1e6
             self._metrics.observe(f"nn_rpc_us|method={method}", dt_us)
@@ -200,6 +360,11 @@ class RpcServer:
                             latency_s=time.perf_counter() - t_start)
         if retry_id is not None:
             self._retry_cache_put(retry_id, out)
+        if spans is not None:
+            # tail bookkeeping (lat window, tenant note, retry cache) stays
+            # attributed — a second dispatch_queue span, same exclusive
+            # class, so the sweep folds it in without a dedicated phase
+            spans.append(("dispatch_queue", t_h1, time.perf_counter()))
         return [req_id, *out]
 
     # RetryCache analog: replayed responses for at-least-once HA retries.

@@ -165,6 +165,8 @@ class HttpGateway:
                             step=q.get("step")))
                     if u.path == "/fsck":
                         return self._json(200, gateway.fsck())
+                    if u.path == "/contention":
+                        return self._json(200, gateway.contention())
                     if not u.path.startswith(PREFIX):
                         return self._json(404, {"error": "not found"})
                     path = unquote(u.path[len(PREFIX):]) or "/"
@@ -608,6 +610,18 @@ class HttpGateway:
         from hdrf_tpu.utils.watchdog import thread_stacks
 
         return {"daemon": "http_gateway", "threads": thread_stacks()}
+
+    def contention(self) -> dict:
+        """The NN's control-plane contention table (rpc_contention RPC:
+        per-method calls/p99/lock-share + the instrumented namesystem
+        lock's books, ISSUE 18) — one fetch for the storm-triage
+        dashboard."""
+        try:
+            with HdrfClient(self._nn_addr, name="http-gw") as c:
+                return c._call("contention")
+        except (OSError, ConnectionError):
+            _M.incr("contention_nn_unreachable")
+            return {"status": "unreachable", "namenode": str(self._nn_addr)}
 
     def timeseries(self, scope: str | None = None,
                    metric: str | None = None, since=None,

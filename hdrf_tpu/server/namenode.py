@@ -36,8 +36,8 @@ from hdrf_tpu.server import permissions as perm
 from hdrf_tpu.server.editlog import EditLog
 from hdrf_tpu.server.permissions import Attrs, DirNode
 from hdrf_tpu.utils import (fault_injection, flight_archive,
-                            flight_recorder, log, metrics, outlier, retry,
-                            tenants, tracing)
+                            flight_recorder, lockprof, log, metrics, outlier,
+                            retry, tenants, tracing)
 from hdrf_tpu.utils.watchdog import StallWatchdog
 
 _M = metrics.registry("namenode")
@@ -266,7 +266,11 @@ class NameNode:
     def __init__(self, config: NameNodeConfig | None = None):
         self.config = config or NameNodeConfig()
         self.role = self.config.role  # "active" | "standby"
-        self._lock = threading.RLock()  # the FSNamesystem lock analog
+        # The FSNamesystem lock analog — instrumented (utils/lockprof.py):
+        # per-RPC-method wait/hold books, saturation, long-hold stacks.
+        self._lock = lockprof.InstrumentedRLock(
+            "nn_lock", registry=_M,
+            long_hold_s=self.config.lock_long_hold_s)
         # The superuser is the NN process owner (dfs.permissions.superusergroup
         # / UGI of the NN, FSPermissionChecker semantics); in-process callers
         # (no wire identity) also act as superuser.
@@ -389,9 +393,10 @@ class NameNode:
         # round 4); optional per-daemon status HTTP endpoint (HttpServer2).
         self.watchdog = StallWatchdog("namenode",
                                       budget_s=self.config.stall_budget_s,
-                                      registry=_M)
+                                      registry=_M, lock=self._lock)
         self._rpc = RpcServer(self.config.host, self.config.port, self,
-                              "namenode", watchdog=self.watchdog)
+                              "namenode", watchdog=self.watchdog,
+                              max_handlers=self.config.rpc_max_handlers)
         # Cluster-level flight recorder (utils/flight_recorder.py): exists
         # even without a status port — the gateway pulls its ring over the
         # flight_timeseries RPC.  Optionally archive-backed so the curve
@@ -417,7 +422,8 @@ class NameNode:
                                             host=self.config.host,
                                             port=self.config.status_port,
                                             watchdog=self.watchdog,
-                                            recorder=self.flight)
+                                            recorder=self.flight,
+                                            contention=self.rpc_contention)
         self._monitor_stop = threading.Event()
         self._monitor: threading.Thread | None = None
         self._logger = log.get_logger("namenode")
@@ -3264,6 +3270,23 @@ class NameNode:
     def rpc_metrics(self) -> dict:
         return metrics.all_snapshots()
 
+    def rpc_contention(self) -> dict:
+        """Control-plane contention observatory (ISSUE 18): the RPC
+        server's per-method service table (calls, p99, phase means,
+        attribution) merged with the instrumented namesystem lock's books
+        — each method row gains its share of total lock hold time.  Served
+        as ``/contention`` on the NN status server and the gateway, and as
+        ``dfsadmin -contention``."""
+        out = self._rpc.contention_summary()
+        lock = self._lock.contention_summary()
+        out["lock"] = lock
+        by_method = lock["by_method"]
+        for m, row in out["methods"].items():
+            lk = by_method.get(m)
+            row["lock_share"] = lk["hold_share"] if lk else 0.0
+            row["lock_hold_s"] = lk["hold_s"] if lk else 0.0
+        return out
+
     def _flight_sample(self) -> dict:
         """Cluster-level flight-recorder gauges: namespace size, replication
         backlogs, live DN population, safemode, per-tenant population and
@@ -3299,8 +3322,16 @@ class NameNode:
         sample["breakers_open"] = sum(1 for s in states if s == "open")
         sample["tenant_count"] = tenants.tenant_count()
         # Metadata-plane latency health (ROADMAP item 2's axis): rolling
-        # p99 over every RPC the server dispatched in the last window.
+        # p99 over every RPC the server dispatched in the last window,
+        # plus the namesystem lock's contention gauges — saturation,
+        # rolling wait p99 and the hold p99 of the heaviest holders —
+        # so a creeping lock convoy shows in /timeseries and slo_report
+        # before it becomes an outage.
         sample["nn_rpc_p99_ms"] = self._rpc.rpc_p99_ms()
+        sample["nn_lock_saturation"] = self._lock.saturation()
+        sample["nn_lock_wait_p99_us"] = self._lock.wait_p99_us()
+        for m, p99 in self._lock.top_methods(3):
+            sample[f"nn_lock_hold_p99_us|method={m}"] = p99
         return sample
 
     def rpc_flight_timeseries(self) -> dict:

@@ -17,7 +17,12 @@ opts into via ``status_port`` config, serving
 - ``/timeseries`` — the daemon's flight-recorder ring (bounded over-time
   gauge samples, utils/flight_recorder.py; nothing in the reference
   serves a curve — MutableRollingAverages keeps a few windowed means and
-  discards the series).
+  discards the series),
+- ``/contention`` — the daemon's lock/RPC contention table (per-method
+  calls/p99/lock-share + the instrumented namesystem lock's books,
+  utils/lockprof.py; the FSNamesystemLock.java:60 metrics plus the RPC
+  decomposition RpcMetrics.java:118 never had, served nowhere in the
+  reference).
 
 The server threads are daemonic and shut down with the owning daemon.
 """
@@ -36,12 +41,16 @@ from hdrf_tpu.utils.watchdog import StallWatchdog, thread_stacks
 class StatusHttpServer:
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
                  watchdog: StallWatchdog | None = None,
-                 recorder=None):
+                 recorder=None, contention=None):
         """``recorder``: optional utils.flight_recorder.FlightRecorder —
-        when set, ``/timeseries`` serves its bounded gauge ring."""
+        when set, ``/timeseries`` serves its bounded gauge ring.
+        ``contention``: optional zero-arg callable returning the daemon's
+        contention table (the NN passes rpc_contention, ISSUE 18) —
+        when set, ``/contention`` serves it."""
         self.name = name
         self._watchdog = watchdog
         self._recorder = recorder
+        self._contention = contention
         status = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -86,6 +95,10 @@ class StatusHttpServer:
                                             since=q.get("since"))
                     return self._send(200, json.dumps(out).encode(),
                                       "application/json")
+                if u.path == "/contention":
+                    return self._send(
+                        200, json.dumps(status.contention()).encode(),
+                        "application/json")
                 self._send(404, b'{"error": "not found"}',
                            "application/json")
 
@@ -135,6 +148,16 @@ class StatusHttpServer:
             out["samples"] = flight_archive.filter_series(
                 out["samples"], metric=metric,
                 since=float(since) if since is not None else None)
+        return out
+
+    def contention(self) -> dict:
+        """The daemon's lock/RPC contention table (utils/lockprof.py +
+        proto/rpc.py contention_summary), or an empty shell for daemons
+        that run without one — the endpoint shape stays stable."""
+        if self._contention is None:
+            return {"daemon": self.name, "methods": {}, "lock": None}
+        out = dict(self._contention())
+        out["daemon"] = self.name
         return out
 
     def stacks(self) -> dict:

@@ -241,7 +241,10 @@ def main(argv: list[str] | None = None) -> int:
                     root, key="coded_exchange.pack_saved_frac")
                 + check_bench_contract(root, key="longhorizon")
                 + check_bench_contract(
-                    root, key="longhorizon.storage_ratio_slope"))
+                    root, key="longhorizon.storage_ratio_slope")
+                + check_bench_contract(root, key="nn")
+                + check_bench_contract(root, key="nn.rpc_p99_ms")
+                + check_bench_contract(root, key="nn.lock_saturation"))
     for p in problems:
         print(p)
     print(f"{len(problems)} violation(s)" if problems

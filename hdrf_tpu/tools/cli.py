@@ -13,7 +13,7 @@ dispatch; DFSAdmin.java:441, OfflineImageViewer / OfflineEditsViewer under
                            -chmod -chown -getfacl -setfacl -setfattr -getfattr
   mover                    migrate replicas to satisfy storage policies
   dfsadmin                 -report -savenamespace -metrics -slowPeers
-                           -ecStatus -fsck
+                           -contention -ecStatus -fsck
                            -movblock -setBalancerBandwidth -provide
                            -allowSnapshot -setQuota -setSpaceQuota -clrQuota
                            -safemode -decommission -decommissionStatus
@@ -287,6 +287,11 @@ def cmd_dfsadmin(args) -> int:
             # the outlier detector's verdict (slow_nodes_report) — peers
             # AND volumes, with the medians they were judged against
             print(json.dumps(c._call("slow_nodes_report"), indent=2))
+        elif args.op == "-contention":
+            # control-plane contention observatory (ISSUE 18): per-method
+            # RPC service table + the namesystem lock's wait/hold books
+            print(json.dumps(c._call("contention"), indent=2,
+                             sort_keys=True))
         elif args.op == "-ecStatus":
             # cold-tier census: striped vs replicated containers and the
             # stripe tier's physical/logical ratio vs replication

@@ -84,6 +84,16 @@ PHASE_CLASS = {
     # wins the interval's class, so this only attributes the queue/window
     # slack that nothing else covers.
     "decode_wait": TRANSPORT,
+    # Control-plane RPC service-time phases (proto/rpc.py _serve_one):
+    # frame/reply socket IO are transport waits; everything between them
+    # is NN host work.  lock_wait is deliberately HOST, not transport —
+    # the whole dispatch sits inside the covering ``handler`` span (HOST),
+    # and the exclusive sweep resolves same-class overlaps by PHASE_ORDER,
+    # so classifying it transport would hide every queued-on-the-namesystem
+    # second under ``handler`` and the contention table would read clean.
+    "frame_read": TRANSPORT, "reply": TRANSPORT,
+    "dispatch_queue": HOST, "lock_wait": HOST, "locked": HOST,
+    "serialize": HOST, "handler": HOST,
 }
 
 # Deterministic attribution order when several phases of the winning class
@@ -93,8 +103,14 @@ PHASE_CLASS = {
 PHASE_ORDER = ("device_wait", "wal_commit", "container_io", "dedup_lookup",
                "reduce_compute", "checksum", "buffer_assemble",
                "pipeline_submit", "index_lookup", "cache_probe",
-               "container_decode", "recv", "mirror_stream", "ack",
-               "ec_gather", "decode_wait", "net_send")
+               "container_decode",
+               # RPC phases: lock_wait/locked win attribution inside the
+               # covering ``handler`` window; handler last among them so it
+               # only owns the time no finer phase explains.
+               "lock_wait", "locked", "dispatch_queue", "serialize",
+               "handler",
+               "recv", "mirror_stream", "ack",
+               "ec_gather", "decode_wait", "net_send", "frame_read", "reply")
 
 
 def phase_class(name: str) -> str:

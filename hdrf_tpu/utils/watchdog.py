@@ -52,8 +52,15 @@ class StallWatchdog:
 
     def __init__(self, name: str, budget_s: float = DEFAULT_BUDGET_S,
                  tick_s: float | None = None,
-                 registry: metrics.MetricsRegistry | None = None) -> None:
+                 registry: metrics.MetricsRegistry | None = None,
+                 lock: Any | None = None) -> None:
+        """``lock``: optional utils.lockprof.InstrumentedRLock — when set,
+        each stall record also captures the CURRENT lock holder's identity,
+        held-for duration and stack, so a lock convoy (N threads parked
+        behind one slow holder) is diagnosable from /stacks instead of
+        showing N identical waiter stacks and no culprit."""
         self.name = name
+        self._profiled_lock = lock
         self.budget_s = budget_s
         self.tick_s = tick_s if tick_s is not None else min(
             max(budget_s / 4.0, 0.01), 2.0)
@@ -119,6 +126,7 @@ class StallWatchdog:
                 if now - ref > ent["budget"]:
                     ent["flagged"] = now
                     stalled.append(dict(ent))
+        holder = self._lock_holder() if stalled else None
         for ent in stalled:
             elapsed = now - ent["t0"]
             # phase the stalled op's thread is in RIGHT NOW (cross-thread
@@ -130,6 +138,8 @@ class StallWatchdog:
                    "budget_s": ent["budget"],
                    "trace_id": ent.get("trace_id"), "phase": phase,
                    "stacks": thread_stacks()}
+            if holder is not None:
+                rec["lock_holder"] = holder
             with self._lock:
                 self._stalls.append(rec)
             self._log.warning("stall", op=ent["op"],
@@ -141,6 +151,25 @@ class StallWatchdog:
                                   trace_id=ent.get("trace_id"), phase=phase)
             self._stall_span(ent, elapsed, phase)
         return len(stalled)
+
+    def _lock_holder(self) -> dict[str, Any] | None:
+        """The profiled lock's current holder with its live stack — the
+        convoy culprit a stall record would otherwise omit (the waiters'
+        stacks all show the same acquire site)."""
+        if self._profiled_lock is None:
+            return None
+        try:
+            h = self._profiled_lock.holder()
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            return None
+        if h is None:
+            return None
+        h = dict(h)
+        h["held_for_s"] = round(h.get("held_for_s", 0.0), 3)
+        frame = sys._current_frames().get(h.get("thread"))
+        if frame is not None:
+            h["stack"] = traceback.format_stack(frame)
+        return h
 
     def _stall_span(self, ent: dict[str, Any], elapsed: float,
                     phase: str | None) -> None:

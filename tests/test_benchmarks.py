@@ -16,10 +16,23 @@ def run(argv) -> list[dict]:
     return [json.loads(line) for line in buf.getvalue().splitlines()]
 
 
-def test_nn_throughput():
-    out = run(["nn", "--ops", "50"])
-    assert {o["op"] for o in out} >= {"mkdir", "delete"}
-    assert all(o["ops_per_s"] > 0 for o in out)
+def test_nn_metadata_storm_one_json_line():
+    """`benchmarks nn` contract (ISSUE 18 acceptance): EXACTLY one JSON
+    line carrying the contention observatory's storm verdict —
+    rpc_p99_ms, lock_saturation, the per-method lock-share curve and the
+    attribution fraction.  Tiny storm: shape and sanity, not the bar."""
+    out = run(["nn", "--ops", "60", "--clients", "3", "--meta-per-op", "2"])
+    assert len(out) == 1
+    (o,) = out
+    assert o["bench"] == "nn_metadata_storm"
+    assert o["clients"] == 3 and o["errors"] == 0
+    assert o["ops_per_s"] > 0 and o["rpc_calls"] > 0
+    assert o["rpc_p99_ms"] > 0
+    assert 0.0 <= o["lock_saturation"] <= 1.0
+    assert o["lock_wait_p99_us"] >= 0.0
+    assert o["top_method"] in o["lock_share"]
+    assert all(0.0 <= v <= 1.0 for v in o["lock_share"].values())
+    assert o["attributed_frac"] >= 0.95
 
 
 def test_dfs_throughput():

@@ -1,0 +1,210 @@
+"""Control-plane contention observatory, end to end.
+
+Exercises ISSUE 18's wiring above the unit-level lockprof math
+(tests/test_lockprof.py): a two-client metadata storm against a real
+MiniCluster with a slow lock holder injected at the ``editlog.append``
+fault point (which fires UNDER the namesystem lock,
+server/editlog.py:145) must show up on ``/contention`` — via the HTTP
+gateway — as mkdir owning the lock, with >= 95% of profiled RPC service
+time attributed to named phases.  Also pins the ``rpc_max_handlers``
+accept-backpressure knob, the watchdog's lock-holder convoy capture
+(utils/watchdog.py), and the ``rpc.dispatch`` fault point
+(proto/rpc.py) the contention plane declares.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from hdrf_tpu.proto.rpc import RpcClient, RpcError, RpcServer
+from hdrf_tpu.server.http_gateway import HttpGateway
+from hdrf_tpu.testing.minicluster import MiniCluster
+from hdrf_tpu.utils import fault_injection, lockprof, metrics
+from hdrf_tpu.utils.watchdog import StallWatchdog
+
+
+def _get_json(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.status == 200
+        return json.loads(r.read())
+
+
+class TestContentionE2E:
+    def test_storm_attributes_slow_holder(self):
+        """Two wire clients mkdir-storm the NN while every edit append
+        sleeps 20 ms under the namesystem lock; /contention (through the
+        gateway) must name mkdir as the dominant lock holder and keep the
+        service-time decomposition >= 95% attributed."""
+        per_client, n_clients = 12, 2
+        with MiniCluster(n_datanodes=1, replication=1) as mc:
+            gw = HttpGateway(mc.namenode.addr).start()
+            try:
+                # The rpc.namenode registry is cumulative per PROCESS
+                # (Prometheus counter semantics), so under a full pytest
+                # run it already holds earlier clusters' traffic — assert
+                # method-table deltas, not absolutes.  The lock books and
+                # attributed_frac are per-NN-instance and need no delta.
+                cont0 = _get_json(
+                    f"http://{gw.addr[0]}:{gw.addr[1]}/contention")
+                mk0 = cont0["methods"].get("mkdir", {})
+                errs = []
+
+                def storm(w):
+                    try:
+                        with RpcClient(mc.namenode.addr) as c:
+                            for i in range(per_client):
+                                c.call("mkdir", path=f"/storm{w}/d{i}")
+                                c.call("stat", path=f"/storm{w}/d{i}")
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+
+                with fault_injection.inject(
+                        "editlog.append", lambda **kw: time.sleep(0.02)):
+                    ts = [threading.Thread(target=storm, args=(w,))
+                          for w in range(n_clients)]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+                assert not errs
+                cont = _get_json(
+                    f"http://{gw.addr[0]}:{gw.addr[1]}/contention")
+
+                # Per-method service table saw every storm call.
+                mk = cont["methods"]["mkdir"]
+                assert mk["calls"] - mk0.get("calls", 0) == \
+                    per_client * n_clients
+                assert mk["errors"] - mk0.get("errors", 0) == 0
+                # The decomposition carved a locked phase out of mkdir.
+                assert mk["phase_us"]["locked"] > 0
+                # Lock books: mkdir owns the hold time (stat takes the
+                # lock too, but without the editlog sleep).
+                by = cont["lock"]["by_method"]
+                assert by["mkdir"]["hold_share"] == max(
+                    r["hold_share"] for r in by.values())
+                assert by["mkdir"]["hold_s"] >= \
+                    0.02 * per_client * n_clients
+                # The method row is stamped with its lock share.
+                assert mk["lock_share"] == pytest.approx(
+                    by["mkdir"]["hold_share"])
+                # Acceptance bar: the exclusive phase partition accounts
+                # for >= 95% of profiled RPC service time.
+                assert cont["attributed_frac"] >= 0.95
+                assert 0.0 <= cont["lock"]["saturation"] <= 1.0
+
+                # Flight sample carries the lock axis for slo_report's
+                # REGRESS_UP comparison.
+                sample = mc.namenode._flight_sample()
+                assert 0.0 <= sample["nn_lock_saturation"] <= 1.0
+                assert sample["nn_lock_wait_p99_us"] >= 0.0
+                assert any(k.startswith("nn_lock_hold_p99_us|method=")
+                           for k in sample)
+            finally:
+                gw.stop()
+
+
+class _AddService:
+    def rpc_add(self, a, b):
+        return a + b
+
+
+class TestMaxHandlers:
+    def test_accept_backpressure(self):
+        """With ``max_handlers=1`` the second connection parks in the
+        accept path until the first client releases its handler thread by
+        disconnecting — listen-backlog backpressure, not an error."""
+        srv = RpcServer("127.0.0.1", 0, _AddService(), "ctest",
+                        max_handlers=1).start()
+        try:
+            c1 = RpcClient(srv.addr)
+            assert c1.call("add", a=1, b=2) == 3  # c1 now owns the slot
+            done = threading.Event()
+            res = []
+
+            def second():
+                with RpcClient(srv.addr) as c2:
+                    res.append(c2.call("add", a=3, b=4))
+                done.set()
+
+            t = threading.Thread(target=second, daemon=True)
+            t.start()
+            # The second call must be parked while c1 holds its
+            # connection (one handler thread per connection).
+            assert not done.wait(0.3)
+            c1.close()
+            assert done.wait(10), "second client never got a handler slot"
+            assert res == [7]
+            t.join()
+            snap = metrics.registry("rpc.ctest").snapshot()["gauges"]
+            assert "rpc_handler_threads" in snap
+            assert "rpc_inflight" in snap
+        finally:
+            srv.stop()
+
+
+class TestWatchdogLockHolder:
+    def test_stall_record_names_the_holder(self):
+        """A stall scan while the instrumented lock is held must capture
+        the holder's method, held-for and live stack on the record — the
+        convoy culprit, not just N identical waiter stacks."""
+        lk = lockprof.InstrumentedRLock("cv_lock")
+        wd = StallWatchdog("cv", budget_s=1.0, tick_s=999, lock=lk)
+        held, release = threading.Event(), threading.Event()
+
+        def slow_holder():
+            with lockprof.bind_request("slow_write"):
+                with lk:
+                    held.set()
+                    release.wait(10)
+
+        t = threading.Thread(target=slow_holder, daemon=True)
+        t.start()
+        assert held.wait(5)
+        try:
+            with wd.track("stuck_op"):
+                t0 = time.monotonic()
+                assert wd.scan(now=t0 + 2) == 1
+        finally:
+            release.set()
+            t.join()
+        rec = wd.stalls()[-1]
+        h = rec["lock_holder"]
+        assert h["method"] == "slow_write"
+        assert h["held_for_s"] >= 0.0
+        assert any("slow_holder" in line for line in h["stack"])
+
+    def test_no_holder_no_key(self):
+        lk = lockprof.InstrumentedRLock("cv_lock2")
+        wd = StallWatchdog("cv2", budget_s=1.0, tick_s=999, lock=lk)
+        with wd.track("stuck_op"):
+            t0 = time.monotonic()
+            assert wd.scan(now=t0 + 2) == 1
+        assert "lock_holder" not in wd.stalls()[-1]
+
+
+class TestDispatchFaultPoint:
+    def test_rpc_dispatch_injection_surfaces_as_rpc_error(self):
+        """``rpc.dispatch`` fires per-dispatch with the server name and
+        method, before the handler runs — an injected raise travels back
+        to the client as a normal RpcError."""
+        srv = RpcServer("127.0.0.1", 0, _AddService(), "ctest2").start()
+        seen = []
+
+        def boom(**kw):
+            seen.append(kw)
+            if kw["method"] == "add":
+                raise ValueError("injected dispatch fault")
+
+        try:
+            with fault_injection.inject("rpc.dispatch", boom):
+                with RpcClient(srv.addr) as c:
+                    with pytest.raises(RpcError) as ei:
+                        c.call("add", a=1, b=2)
+            assert ei.value.error == "ValueError"
+            assert seen and seen[0]["server"] == "ctest2"
+            assert seen[0]["method"] == "add"
+        finally:
+            srv.stop()
