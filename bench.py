@@ -784,6 +784,11 @@ def _nn_summary() -> dict:
     argv = [sys.executable, "-m", "hdrf_tpu.benchmarks", "nn"]
     argv += (["--ops", "80", "--clients", "4", "--meta-per-op", "2"]
              if smoke else ["--ops", "1500", "--clients", "8"])
+    # second child: the ISSUE 20 observer A/B legs (small paired rounds —
+    # the stamp wants the observer-plane keys, not a full soak)
+    ab_argv = [sys.executable, "-m", "hdrf_tpu.benchmarks", "nn",
+               "--observer-ab", "--ops", "40", "--clients", "2",
+               "--meta-per-op", "2", "--rounds", "1"]
     try:
         proc = subprocess.run(
             argv, capture_output=True, text=True, timeout=600,
@@ -794,16 +799,29 @@ def _nn_summary() -> dict:
     except Exception as e:          # noqa: BLE001 — stamp must never raise
         return {"ok": False, "error": repr(e)[:200], "rpc_p99_ms": 0.0,
                 "lock_saturation": 0.0, "lock_wait_p99_us": 0.0,
-                "top_method": None}
+                "top_method": None, "observer_reads": 0,
+                "observer_share": 0.0, "msync_p99_ms": 0.0,
+                "observer_lag_txids": 0}
     if proc.returncode != 0:
         return {"ok": False, "error": proc.stderr.strip()[-200:],
                 "rpc_p99_ms": 0.0, "lock_saturation": 0.0,
-                "lock_wait_p99_us": 0.0, "top_method": None}
+                "lock_wait_p99_us": 0.0, "top_method": None,
+                "observer_reads": 0, "observer_share": 0.0,
+                "msync_p99_ms": 0.0, "observer_lag_txids": 0}
+    try:
+        ab_proc = subprocess.run(
+            ab_argv, capture_output=True, text=True, timeout=600,
+            env=clean_cpu_env(8),
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        ab = json.loads(ab_proc.stdout.strip().splitlines()[-1])
+        ab_ok = ab_proc.returncode == 0 and ab.get("errors", 1) == 0
+    except Exception:               # noqa: BLE001 — stamp must never raise
+        ab, ab_ok = {}, False
     return {
         # the observatory's own health bar: every profiled RPC's service
         # time >= 95% attributed to named phases, and a clean storm
         "ok": bool(out.get("attributed_frac", 0.0) >= 0.95
-                   and out.get("errors", 1) == 0),
+                   and out.get("errors", 1) == 0 and ab_ok),
         "clients": out.get("clients", 0),
         "ops_per_s": out.get("ops_per_s", 0),
         "rpc_p99_ms": out.get("rpc_p99_ms", 0.0),
@@ -812,6 +830,14 @@ def _nn_summary() -> dict:
         "top_method": out.get("top_method"),
         "lock_share": out.get("lock_share", {}),
         "attributed_frac": out.get("attributed_frac", 0.0),
+        # ISSUE 20 observer plane (from the paired A/B child)
+        "observer_reads": ab.get("observer_reads", 0),
+        "observer_share": ab.get("observer_share", 0.0),
+        "msync_p99_ms": ab.get("msync_p99_ms", 0.0),
+        "observer_lag_txids": ab.get("observer_lag_txids", 0),
+        "observer_read_p99_ratio": ab.get("read_p99_ratio", 0.0),
+        "active_read_lock_share_b": ab.get(
+            "b", {}).get("active_read_lock_share", 0.0),
     }
 
 

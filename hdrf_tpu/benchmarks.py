@@ -38,6 +38,254 @@ def _rate(n: int, t0: float) -> float:
     return n / (time.perf_counter() - t0)
 
 
+def _nn_observer_ab(args) -> None:
+    """Paired observer A/B (ISSUE 20): the same metadata storm run twice
+    per round — leg A against a lone active, leg B with ``--observers``
+    observer NNs tailing it and the HA proxy routing reads observer-first
+    (state-id protocol; one msync barrier per data op buys read-your-writes
+    for the reads that follow).  Medians over ``--rounds`` rounds (the
+    PERF_NOTES paired-pass discipline: the VM's write-burst throttling
+    hits whichever leg draws it).  Prints ONE JSON line: per-leg read p99
+    + the ACTIVE's lock share of the read methods (the PR 18 /contention
+    decomposition — near-zero in leg B is the whole point), plus
+    observer_reads / observer_share / msync_p99_ms / observer_lag_txids."""
+    import dataclasses
+    import tempfile
+    import threading
+
+    from hdrf_tpu.config import NameNodeConfig
+    from hdrf_tpu.proto.rpc import HaRpcClient
+    from hdrf_tpu.server.namenode import NameNode
+    from hdrf_tpu.utils import metrics, retry
+
+    read_methods = ("stat", "get_block_locations", "listing")
+
+    def _counter(reg: str, key: str) -> int:
+        return metrics.registry(reg).snapshot()["counters"].get(key, 0)
+
+    def leg(observer: bool) -> dict:
+        clients = max(1, args.clients)
+        per = max(1, args.ops // clients)
+        meta = max(0, args.meta_per_op)
+        obs_reads0 = _counter("client.ha", "observer_reads")
+        bounces0 = _counter("client.ha", "observer_bounces")
+        with tempfile.TemporaryDirectory() as d:
+            cfg = NameNodeConfig(
+                meta_dir=d, replication=1, heartbeat_interval_s=30.0,
+                dead_node_interval_s=600.0, tail_interval_s=0.02)
+            nn = NameNode(cfg).start()
+            obs = []
+            try:
+                nn.rpc_register_datanode("dn-bench", ["127.0.0.1", 1])
+                if observer:
+                    for _k in range(max(1, args.observers)):
+                        ob = NameNode(dataclasses.replace(
+                            cfg, role="observer", port=0)).start()
+                        ob.rpc_register_datanode("dn-bench",
+                                                 ["127.0.0.1", 1])
+                        obs.append(ob)
+                addrs = [nn.addr] + [o.addr for o in obs]
+                read_ms = [[] for _ in range(clients)]
+                msync_ms = [[] for _ in range(clients)]
+                errors = [0] * clients
+                calls = [0] * clients
+
+                def storm(w: int) -> None:
+                    ha = HaRpcClient(addrs, observer_reads=observer)
+                    try:
+                        for i in range(per):
+                            p = f"/storm/c{w}/{i // args.files}/f{i}"
+                            try:
+                                ha.call("create", path=p, client=f"s{w}")
+                                alloc = ha.call("add_block", path=p,
+                                                client=f"s{w}")
+                                ha.call("complete", path=p, client=f"s{w}",
+                                        block_lengths={
+                                            alloc["block_id"]: 1024})
+                                calls[w] += 3
+                                if observer:
+                                    t = time.perf_counter()
+                                    ha.msync(wait_s=1.0)
+                                    msync_ms[w].append(
+                                        (time.perf_counter() - t) * 1e3)
+                                for j in range(meta):
+                                    which = (i * meta + j) % 3
+                                    t = time.perf_counter()
+                                    if which == 0:
+                                        ha.call("stat", path=p)
+                                    elif which == 1:
+                                        ha.call("get_block_locations",
+                                                path=p)
+                                    else:
+                                        ha.call("listing",
+                                                path=f"/storm/c{w}/"
+                                                     f"{i // args.files}")
+                                    read_ms[w].append(
+                                        (time.perf_counter() - t) * 1e3)
+                                    calls[w] += 1
+                            except Exception:  # noqa: BLE001 — count on
+                                errors[w] += 1
+                    finally:
+                        ha.close()
+
+                t0 = time.perf_counter()
+                ts = [threading.Thread(target=storm, args=(w,))
+                      for w in range(clients)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                dt = time.perf_counter() - t0
+                lock = nn.rpc_contention()["lock"]
+                flat = [x for lat in read_ms for x in lat]
+                msy = [x for lat in msync_ms for x in lat]
+                lag_txids = max((nn._editlog.seq - o._editlog.seq
+                                 for o in obs), default=0)
+                obs_reads = _counter("client.ha",
+                                     "observer_reads") - obs_reads0
+                return {
+                    "ops_per_s": round(sum(calls) / dt) if dt > 0 else 0,
+                    "errors": sum(errors),
+                    "read_p99_ms": round(float(
+                        np.percentile(flat, 99)) if flat else 0.0, 3),
+                    "active_read_lock_share": round(sum(
+                        lock["by_method"].get(m, {}).get("hold_share", 0.0)
+                        for m in read_methods), 4),
+                    "observer_reads": obs_reads,
+                    "observer_share": round(obs_reads / len(flat), 4)
+                    if flat else 0.0,
+                    "observer_bounces": _counter(
+                        "client.ha", "observer_bounces") - bounces0,
+                    "msync_p99_ms": round(float(
+                        np.percentile(msy, 99)) if msy else 0.0, 3),
+                    "observer_lag_txids": lag_txids,
+                }
+            finally:
+                for o in obs:
+                    o.stop()
+                nn.stop()
+                retry.reset_breakers()
+
+    rounds = max(1, args.rounds)
+    a_rounds = [leg(False) for _ in range(rounds)]
+    b_rounds = [leg(True) for _ in range(rounds)]
+
+    def med(rs: list[dict], key: str) -> float:
+        return float(np.median([r[key] for r in rs]))
+
+    a_p99, b_p99 = med(a_rounds, "read_p99_ms"), med(b_rounds, "read_p99_ms")
+    print(json.dumps({
+        "bench": "nn_observer_ab",
+        "rounds": rounds,
+        "clients": max(1, args.clients),
+        "data_ops": max(1, args.ops // max(1, args.clients))
+        * max(1, args.clients),
+        "observers": max(1, args.observers),
+        "a": {"read_p99_ms": round(a_p99, 3),
+              "active_read_lock_share": round(
+                  med(a_rounds, "active_read_lock_share"), 4),
+              "ops_per_s": round(med(a_rounds, "ops_per_s"))},
+        "b": {"read_p99_ms": round(b_p99, 3),
+              "active_read_lock_share": round(
+                  med(b_rounds, "active_read_lock_share"), 4),
+              "ops_per_s": round(med(b_rounds, "ops_per_s"))},
+        "read_p99_ratio": round(b_p99 / a_p99, 3) if a_p99 > 0 else 0.0,
+        "observer_reads": round(med(b_rounds, "observer_reads")),
+        "observer_share": round(med(b_rounds, "observer_share"), 4),
+        "observer_bounces": round(med(b_rounds, "observer_bounces")),
+        "msync_p99_ms": round(med(b_rounds, "msync_p99_ms"), 3),
+        "observer_lag_txids": round(med(b_rounds, "observer_lag_txids")),
+        "errors": sum(r["errors"] for r in a_rounds + b_rounds),
+    }))
+
+
+def _nn_kill_active(args) -> None:
+    """Kill-active-mid-storm scenario (ISSUE 20): readers hammer a file
+    through the HA proxy (observer-routed) while the active NN dies
+    abruptly a third of the way in; a FailoverController promotes the
+    standby while observers keep serving staleness-bounded reads.  Prints
+    ONE JSON line: reads served, read errors, responses staler than the
+    bound (must be 0 — bounced reads retry, they never lie), and the
+    write-unavailability window (kill -> first post-promotion write)."""
+    import threading
+
+    from hdrf_tpu.server.failover import FailoverController
+    from hdrf_tpu.testing.minicluster import MiniCluster
+    from hdrf_tpu.utils import metrics
+
+    def _counter(reg: str, key: str) -> int:
+        return metrics.registry(reg).snapshot()["counters"].get(key, 0)
+
+    payload = b"observer-kill-active" * 200
+    dur = max(2.0, args.duration)
+    readers = max(1, args.clients)
+    obs_reads0 = _counter("client.ha", "observer_reads")
+    bounces0 = _counter("client.ha", "observer_bounces")
+    with MiniCluster(n_datanodes=1, replication=1, ha=True,
+                     observers=max(1, args.observers)) as mc:
+        with mc.client("seed") as c:
+            c.write("/kill/f0", payload)
+            c.msync(wait_s=2.0)
+        fc = FailoverController(mc.nn_addrs(), probe_interval_s=0.2,
+                                grace=2).start()
+        stop = threading.Event()
+        reads = [0] * readers
+        read_errors = [0] * readers
+        stale = [0] * readers
+
+        def reader(w: int) -> None:
+            with mc.client(f"reader-{w}") as c:
+                while not stop.is_set():
+                    try:
+                        data = c.read("/kill/f0")
+                    except Exception:  # noqa: BLE001 — the verdict counts
+                        read_errors[w] += 1
+                        time.sleep(0.05)
+                        continue
+                    reads[w] += 1
+                    if data != payload:
+                        stale[w] += 1
+
+        ts = [threading.Thread(target=reader, args=(w,))
+              for w in range(readers)]
+        for t in ts:
+            t.start()
+        time.sleep(dur / 3)
+        t_kill = time.perf_counter()
+        mc.kill_namenode()
+        # write probe: the moment a mutation lands again, promotion is done
+        failover_s = None
+        deadline = time.monotonic() + dur
+        with mc.client("write-probe") as c:
+            k = 0
+            while time.monotonic() < deadline:
+                try:
+                    c.write(f"/kill/probe{k}", b"x")
+                    failover_s = time.perf_counter() - t_kill
+                    break
+                except Exception:  # noqa: BLE001 — still failing over
+                    k += 1
+                    time.sleep(0.1)
+        time.sleep(max(0.0, dur / 3))
+        stop.set()
+        for t in ts:
+            t.join()
+        fc.stop()
+    print(json.dumps({
+        "bench": "nn_kill_active",
+        "duration_s": dur,
+        "readers": readers,
+        "reads": sum(reads),
+        "read_errors": sum(read_errors),
+        "stale_beyond_bound": sum(stale),
+        "failover_s": round(failover_s, 3) if failover_s else None,
+        "observer_reads": _counter("client.ha",
+                                   "observer_reads") - obs_reads0,
+        "observer_bounces": _counter("client.ha",
+                                     "observer_bounces") - bounces0,
+    }))
+
+
 def bench_nn(args) -> None:
     """Metadata-storm harness (ISSUE 18; the NNThroughputBenchmark.java:97
     successor): ``--clients`` concurrent WIRE clients each run a data op
@@ -48,7 +296,14 @@ def bench_nn(args) -> None:
     decomposition, the lock books and the handler-pool gauges all
     populate.  Prints exactly ONE JSON line: throughput, rolling
     ``rpc_p99_ms``, ``lock_saturation``, the rolling lock-wait p99, the
-    top lock-holding method and the per-method lock-share curve."""
+    top lock-holding method and the per-method lock-share curve.
+
+    ISSUE 20 modes: ``--observer-ab`` runs the paired observer A/B legs,
+    ``--kill-active`` the kill-active-mid-storm failover scenario."""
+    if getattr(args, "observer_ab", False):
+        return _nn_observer_ab(args)
+    if getattr(args, "kill_active", False):
+        return _nn_kill_active(args)
     import tempfile
     import threading
 
@@ -1078,6 +1333,16 @@ def main(argv: list[str] | None = None) -> int:
                    help="stat/getBlockLocations/listing calls per data op")
     d.add_argument("--files", type=int, default=100,
                    help="files per listing directory (rotation width)")
+    d.add_argument("--observer-ab", action="store_true",
+                   help="paired A/B: storm with vs without observer reads")
+    d.add_argument("--kill-active", action="store_true",
+                   help="kill the active mid-storm; observers keep serving")
+    d.add_argument("--observers", type=int, default=1,
+                   help="observer NNs in --observer-ab/--kill-active modes")
+    d.add_argument("--rounds", type=int, default=5,
+                   help="paired rounds to median over (--observer-ab)")
+    d.add_argument("--duration", type=float, default=6.0,
+                   help="storm duration in seconds (--kill-active)")
     d.set_defaults(fn=bench_nn)
     d = sub.add_parser("dfs")
     d.add_argument("--mb", type=int, default=64)

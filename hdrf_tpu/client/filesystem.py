@@ -17,11 +17,18 @@ client:
   order, failing over on connection/checksum errors (read failover,
   DFSInputStream.java:621+).  Range reads request only the overlapping
   blocks and byte ranges (reconstruction stays chunk-granular end-to-end).
+- observer metadata plane (ISSUE 20): reads route to observer NNs through
+  the HA proxy's state-id protocol (ObserverReadProxyProvider.java:60),
+  ``msync`` exposes the consistency barrier, and an opt-in LRU+TTL
+  metadata cache (block locations + stats) is invalidated by txid
+  generation, so hot-path re-reads skip the NN fleet entirely.
 """
 
 from __future__ import annotations
 
+import collections
 import socket
+import threading
 import time
 import uuid
 
@@ -53,9 +60,19 @@ class HdrfClient:
         from hdrf_tpu.proto.rpc import HaRpcClient, normalize_addrs
 
         addrs = normalize_addrs(namenode_addr)
-        self._nn = (HaRpcClient(addrs) if len(addrs) > 1
-                    else RpcClient(addrs[0]))
+        self._nn = (HaRpcClient(addrs,
+                                observer_reads=self.config.observer_reads)
+                    if len(addrs) > 1 else RpcClient(addrs[0]))
         self._sc_cache = None  # lazy ShortCircuitCache (fd + shm slots)
+        # Client-side metadata cache (block locations + stats; LRU with
+        # TTL) invalidated by txid GENERATION: entries remember the
+        # highest journal txid this client had observed at insert and are
+        # served only while that hasn't moved — any mutation the client
+        # sees (its own writes included, via the reply-envelope state
+        # stamp) invalidates the whole generation at once.  Off unless
+        # metadata_cache_ttl_s > 0.
+        self._meta_cache: collections.OrderedDict = collections.OrderedDict()
+        self._meta_lock = threading.Lock()
         # Rolling window of successful block-read latencies: its p95 sets
         # the hedged-read trigger (utils/rollwin.py, the same discipline
         # as the mirror plane's per-peer hedge windows).
@@ -117,6 +134,43 @@ class HdrfClient:
                 if not hit:
                     raise
         raise IOError("too many levels of symbolic links")
+
+    def _cached_meta(self, method: str, path: str):
+        """``stat``/``get_block_locations`` through the LRU+TTL metadata
+        cache.  A hit requires the entry to be unexpired AND inserted at
+        the client's CURRENT txid generation — ``last_seen_txid`` advances
+        on every reply that observed a newer journal state, so a bumped
+        generation invalidates everything older in one comparison."""
+        ttl = self.config.metadata_cache_ttl_s
+        if ttl <= 0:
+            return self._call(method, path=path)
+        gen = getattr(self._nn, "last_seen_txid", 0)
+        key = (method, path)
+        now = time.monotonic()
+        with self._meta_lock:
+            ent = self._meta_cache.get(key)
+            if ent is not None and ent[0] > now and ent[1] == gen:
+                self._meta_cache.move_to_end(key)
+                _M.incr("meta_cache_hits")
+                return ent[2]
+        _M.incr("meta_cache_misses")
+        out = self._call(method, path=path)
+        gen = getattr(self._nn, "last_seen_txid", 0)  # post-reply generation
+        with self._meta_lock:
+            self._meta_cache[key] = (now + ttl, gen, out)
+            self._meta_cache.move_to_end(key)
+            while len(self._meta_cache) > self.config.metadata_cache_entries:
+                self._meta_cache.popitem(last=False)
+        return out
+
+    def msync(self, wait_s: float | None = None) -> dict:
+        """Consistency barrier (FileSystem.msync analog): wait until every
+        reachable observer has applied this client's last-seen txid, so
+        subsequent observer reads are read-your-writes.  A single-NN
+        client talks straight to the active — already consistent — and
+        returns {}."""
+        ms = getattr(self._nn, "msync", None)
+        return ms(wait_s=wait_s) if ms is not None else {}
 
     def renew_delegation_token(self) -> float:
         return self._call("renew_delegation_token", token=self._dtoken)
@@ -204,7 +258,7 @@ class HdrfClient:
         return self._call("listing", path=path)
 
     def stat(self, path: str) -> dict:
-        return self._call("stat", path=path)
+        return self._cached_meta("stat", path)
 
     def exists(self, path: str) -> bool:
         try:
@@ -545,7 +599,22 @@ class HdrfClient:
         """Read [offset, offset+length) of a file (whole file by default)."""
         with self._op_deadline(), _TR.span("read") as sp:
             sp.annotate("path", path)
-            loc = self._call("get_block_locations", path=path)
+            loc = self._cached_meta("get_block_locations", path)
+            if not loc.get("ec") and any(not b["locations"]
+                                         for b in loc["blocks"]):
+                # Observer block maps are eventually consistent: IBRs race
+                # the journal tail, so a freshly-completed block can show
+                # zero locations there even after msync (which fences the
+                # NAMESPACE txid only).  Bounce the locations fetch to the
+                # active (_sid in kwargs skips observer routing) and drop
+                # the stale cache entry rather than failing the read.
+                _M.incr("observer_empty_locations")
+                with self._meta_lock:
+                    self._meta_cache.pop(("get_block_locations", path),
+                                         None)
+                loc = self._call("get_block_locations", path=path,
+                                 _sid=getattr(self._nn, "last_seen_txid",
+                                              0))
             total = loc["length"]
             end = total if length < 0 else min(offset + length, total)
             if offset >= end:

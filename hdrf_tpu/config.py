@@ -254,10 +254,25 @@ class NameNodeConfig:
     nameservice_id: str = "ns0"
     block_pool_index: int = 0
     # HA: "active" serves + writes the journal; "standby" tails it read-only
-    # and answers (possibly slightly stale) reads until failover.
+    # and answers (possibly slightly stale) reads until failover; "observer"
+    # tails like a standby but serves the read-only RPC set to clients with
+    # a staleness bound (ObserverReadProxyProvider analog) and is never a
+    # failover candidate.
     role: str = "active"
     # Standby journal catch-up cadence (EditLogTailer interval analog).
     tail_interval_s: float = 0.5
+    # Observer read plane (design decision 19).  A read carrying a client
+    # state-id the observer hasn't applied yet waits at most
+    # observer_wait_s for the tailer to catch up, then bounces the call
+    # back to the active (typed ObserverStaleError — never silently
+    # stale).  Independently, reads are refused whenever the last
+    # successful tail pass is older than observer_max_lag_s (the hard
+    # staleness bound, dfs.ha.tail-edits.period + observer staleness
+    # check analog).  observer_msync_wait_s bounds a parameterless
+    # rpc_msync barrier.
+    observer_wait_s: float = 0.25
+    observer_max_lag_s: float = 5.0
+    observer_msync_wait_s: float = 5.0
     # Block access tokens (dfs.block.access.token.enable analog): NN mints
     # HMAC tokens, DNs verify; keys ride heartbeat responses.
     block_tokens: bool = False
@@ -441,6 +456,19 @@ class ClientConfig:
     # Hedge-delay floor/fallback (s): used before the latency window has
     # samples, and as a lower bound so a cold window never hedges at ~0 s.
     read_hedge_floor_s: float = 0.05
+    # Observer reads (ObserverReadProxyProvider analog): route read-only
+    # NameNode RPCs to observer endpoints first, carrying last_seen_txid
+    # for read-your-writes.  No-op when the endpoint list has no observer.
+    observer_reads: bool = True
+    # Client-side metadata cache (block locations + stats, LRU with TTL)
+    # invalidated by txid generation: an entry is served only while the
+    # client has observed NO newer journal txid than at insert time, so
+    # any mutation this client sees (its own writes included — replies
+    # piggyback the txid) invalidates at once.  ttl <= 0 disables (the
+    # default: block locations are soft state, so caching is opt-in for
+    # read-hot workloads that tolerate bounded staleness).
+    metadata_cache_ttl_s: float = 0.0
+    metadata_cache_entries: int = 256
 
 
 @dataclass

@@ -10,6 +10,16 @@ Request body:  ``[req_id, method, kwargs]``; kwargs may carry ``_trace``, a
 Response body: ``[req_id, 0, result]`` or ``[req_id, 1, {"error", "message"}]``
 — errors round-trip as :class:`RpcError` (the IPC RemoteException analog).
 
+State-id protocol (ISSUE 20): a service exposing ``_rpc_state_id()`` (the
+NameNode) gets that dict appended as a FOURTH reply element on every wire
+response — ``[req_id, status, payload, {"txid", "role", "lag_s"}]`` — and
+clients piggyback their high-water ``last_seen_txid`` back as the ``_sid``
+side-channel kwarg, which an observer's ``_rpc_observer_gate`` hook enforces
+before dispatch.  This re-expresses the reference's RpcRequestHeaderProto
+``stateId`` / GlobalStateIdContext.java:40 + ObserverReadProxyProvider.java:60
+read-your-writes plumbing on the msgpack channel; clients unpacking with
+``*extra`` stay compatible with 3-element replies from stateless services.
+
 Server threading model is thread-per-connection, mirroring the reference's
 thread-per-DataXceiver design (DataXceiverServer.java:44) — but bounded:
 ``max_handlers`` caps live handler threads the way ``dfs.datanode.max.transfer
@@ -217,6 +227,12 @@ class RpcServer:
             resp = self._dispatch(req, spans=spans)
         finally:
             self._note_inflight(-1)
+        # State-id stamp: one hook point covers every wire reply — success,
+        # error, auth refusal and retry-cache replay alike — so the client's
+        # txid high-water mark advances no matter how the call ended.
+        state = self._state_stamp()
+        if state is not None:
+            resp = resp + [state]
         t_ser0 = time.perf_counter()
         payload = msgpack.packb(resp)
         if len(payload) > MAX_FRAME:
@@ -279,6 +295,17 @@ class RpcServer:
                 "max_handlers": self.max_handlers,
                 "methods": methods}
 
+    def _state_stamp(self) -> dict | None:
+        """The service's reply-envelope state dict (None for stateless
+        services — their replies stay 3 elements, old-wire compatible)."""
+        hook = getattr(self._service, "_rpc_state_id", None)
+        if hook is None:
+            return None
+        try:
+            return hook()
+        except Exception:  # noqa: BLE001 — a stamp must never kill a reply
+            return None
+
     def _dispatch(self, req: list, spans: list | None = None) -> list:
         req_id, method, kwargs = req
         # dispatch_queue starts where frame_read ended: side-channel
@@ -287,6 +314,7 @@ class RpcServer:
         trace = kwargs.pop("_trace", None)
         retry_id = kwargs.pop("_retry_id", None)
         dtoken = kwargs.pop("_dtoken", None)
+        sid = kwargs.pop("_sid", None)
         # Hop-by-hop deadline budget (remaining seconds, riding beside
         # _trace): a request arriving with a spent budget is refused
         # BEFORE dispatch — the caller already gave up, so running the
@@ -317,6 +345,18 @@ class RpcServer:
                 auth(method, dtoken)
             except Exception as e:  # noqa: BLE001 — refusal crosses the wire
                 self._metrics.incr(f"{method}_auth_rejected")
+                return [req_id, 1, {"error": type(e).__name__,
+                                    "message": str(e)}]
+        # Observer read gate (_sid consistency check): on an observer this
+        # refuses non-reads, waits out the bounded catch-up window for the
+        # caller's state-id and enforces the staleness bound.  Runs before
+        # the retry cache — a bounced read was never executed here.
+        gate = getattr(self._service, "_rpc_observer_gate", None)
+        if gate is not None:
+            try:
+                gate(method, sid)
+            except Exception as e:  # noqa: BLE001 — bounce crosses the wire
+                self._metrics.incr("observer_refused")
                 return [req_id, 1, {"error": type(e).__name__,
                                     "message": str(e)}]
         if retry_id is not None:
@@ -418,19 +458,153 @@ def normalize_addrs(addr) -> list[tuple[str, int]]:
     return [(addr[0], int(addr[1]))]
 
 
+_HM = metrics.registry("client.ha")
+_MISS = object()  # sentinel: no observer could answer; fall back to active
+
+
 class HaRpcClient:
     """Failover proxy over an ordered NN list (the reference's
     ConfiguredFailoverProxyProvider + RetryProxy analog): on connection
     failure or StandbyError, rotate to the next address; remember the last
-    good one."""
+    good one.
+
+    Observer routing (ObserverReadProxyProvider.java:60 analog): with
+    ``observer_reads`` on, READ_METHODS are offered to every known observer
+    first, carrying the proxy's ``last_seen_txid`` as the ``_sid``
+    side-channel for read-your-writes.  A stale observer bounces the call
+    with a typed ObserverStaleError — counted, retried on the active, never
+    silently stale; a dead one trips its per-endpoint circuit breaker
+    (utils/retry.py breaker registry) and is skipped until it half-opens.
+    Endpoint roles are discovered lazily over ``ha_state`` and refreshed on
+    a TTL, so a promotion or observer restart is picked up without
+    reconfiguration."""
 
     RETRIABLE = ("StandbyError",)
+    # Client-side mirror of the NN's observer-servable read set: only these
+    # are worth offering to a read replica (everything else either mutates
+    # or is NN-instance-specific admin plumbing).
+    READ_METHODS = frozenset({
+        "get_block_locations", "stat", "listing", "ec_status",
+        "content_summary", "get_xattrs", "get_acl", "get_storage_policy",
+        "list_snapshots", "snapshot_diff", "list_cache_pools",
+        "list_cache_directives", "list_encryption_zones", "get_ez",
+        "datanode_report", "cluster_status", "decommission_status",
+        "slow_nodes_report", "slow_peers", "policy_violations",
+        "get_events", "fsck", "check_delegation_token",
+    })
+    ROLE_TTL_S = 10.0
 
-    def __init__(self, addrs: list[tuple[str, int]], timeout: float = 30.0):
+    def __init__(self, addrs: list[tuple[str, int]], timeout: float = 30.0,
+                 observer_reads: bool = True):
         self._clients = [RpcClient(a, timeout) for a in normalize_addrs(addrs)]
         self._cur = 0
+        self.observer_reads = observer_reads
+        self._roles: list[str | None] = [None] * len(self._clients)
+        self._roles_t = float("-inf")  # first use forces a discovery pass
+        # High-water journal txid observed across ALL endpoints (the
+        # ClientGSIContext the reference keeps per-proxy-provider).
+        self.last_seen_txid = 0
+
+    def _breaker(self, c: "RpcClient"):
+        return retry.breaker(f"nn:{c._addr[0]}:{c._addr[1]}")
+
+    def _note_state(self, c: "RpcClient") -> None:
+        if c.last_seen_txid > self.last_seen_txid:
+            self.last_seen_txid = c.last_seen_txid
+
+    def _refresh_roles(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._roles_t < self.ROLE_TTL_S:
+            return
+        self._roles_t = now
+        for i, c in enumerate(self._clients):
+            br = self._breaker(c)
+            if not br.allow():
+                self._roles[i] = None
+                continue
+            try:
+                st = c.call("ha_state")
+            except (ConnectionError, OSError):
+                br.record_failure()
+                self._roles[i] = None
+                continue
+            except RpcError:
+                br.record_success()  # endpoint alive, role just unknown
+                self._roles[i] = None
+                continue
+            br.record_success()
+            self._note_state(c)
+            self._roles[i] = st.get("role")
+
+    def _observer_call(self, method: str, kwargs: dict) -> Any:
+        """Offer a read to each known observer; _MISS means none answered
+        (no observers configured, all stale/bounced, or breakers open)."""
+        self._refresh_roles()
+        for i, role in enumerate(self._roles):
+            if role != "observer":
+                continue
+            c = self._clients[i]
+            br = self._breaker(c)
+            if not br.allow():
+                _HM.incr("observer_skipped_open")
+                continue
+            kw = dict(kwargs)
+            kw["_sid"] = self.last_seen_txid
+            try:
+                out = c.call(method, **kw)
+            except retry.DeadlineExceeded:
+                raise
+            except (ConnectionError, OSError):
+                # dead observer: the BREAKER is the demotion — the role map
+                # keeps the entry so the strike count accumulates across
+                # reads (connect-refused fails fast), and once open,
+                # allow() gates this endpoint to half-open probes only
+                br.record_failure()
+                _HM.incr("observer_demotions")
+                continue
+            except RpcError as e:
+                br.record_success()
+                self._note_state(c)
+                if e.error == "ObserverStaleError":
+                    _HM.incr("observer_bounces")
+                    continue  # bounded-staleness bounce: active serves it
+                if e.error == "StandbyError":
+                    self._roles[i] = None  # role changed under us
+                    continue
+                raise  # real application error from a consistent read
+            br.record_success()
+            self._note_state(c)
+            _HM.incr("observer_reads")
+            return out
+        return _MISS
+
+    def msync(self, wait_s: float | None = None) -> dict:
+        """Consistency barrier (FileSystem.msync analog): ask every
+        reachable observer to catch up to this proxy's ``last_seen_txid``.
+        Returns per-endpoint msync replies ({} with no observers — a
+        single active is strongly consistent already)."""
+        self._refresh_roles(force="observer" not in self._roles)
+        out: dict[str, Any] = {}
+        for i, role in enumerate(self._roles):
+            if role != "observer":
+                continue
+            c = self._clients[i]
+            kw: dict[str, Any] = {"txid": self.last_seen_txid}
+            if wait_s is not None:
+                kw["wait_s"] = wait_s
+            try:
+                out[f"{c._addr[0]}:{c._addr[1]}"] = c.call("msync", **kw)
+                self._note_state(c)
+            except (ConnectionError, OSError, RpcError):
+                continue
+        return out
 
     def call(self, method: str, **kwargs: Any) -> Any:
+        if (self.observer_reads and method in self.READ_METHODS
+                and "_sid" not in kwargs):
+            out = self._observer_call(method, kwargs)
+            if out is not _MISS:
+                return out
         # One retry id per LOGICAL call: a mutation that succeeded just before
         # the connection died must not re-execute when the proxy retries — the
         # server's retry cache replays the original response instead (the
@@ -443,23 +617,35 @@ class HaRpcClient:
         # second lap onward: capped full-jitter backoff instead of a fixed
         # beat, so a thundering herd of proxies doesn't re-poll in lockstep
         delays = retry.backoff_delays(attempts, base_s=0.1, cap_s=2.0)
-        for attempt in range(attempts):
+        # Known observers are not failover targets — skip them for free
+        # (no attempt consumed) unless they are all we have.
+        n_obs = sum(1 for r in self._roles if r == "observer")
+        skip_observers = 0 < n_obs < len(self._clients)
+        attempt = 0
+        while attempt < attempts:
             dl = retry.current()
             if dl is not None:
                 dl.check("namenode failover")  # spent budget: stop retrying
+            if skip_observers and self._roles[self._cur] == "observer":
+                self._cur = (self._cur + 1) % len(self._clients)
+                continue
             c = self._clients[self._cur]
+            attempt += 1
             try:
-                return c.call(method, **kwargs)
+                out = c.call(method, **kwargs)
+                self._note_state(c)
+                return out
             except retry.DeadlineExceeded:
                 raise
             except (ConnectionError, OSError) as e:
                 last = e
             except RpcError as e:
+                self._note_state(c)
                 if e.error not in self.RETRIABLE:
                     raise
                 last = e
             self._cur = (self._cur + 1) % len(self._clients)
-            if attempt >= len(self._clients):
+            if attempt > len(self._clients):
                 import time as _t
 
                 delay = next(delays)
@@ -490,6 +676,11 @@ class RpcClient:
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._req_id = 0
+        # State-id bookkeeping (ClientGSIContext analog): the last reply's
+        # state stamp and the high-water journal txid this client has
+        # observed — what observer reads present as ``_sid``.
+        self.last_state: dict | None = None
+        self.last_seen_txid = 0
 
     def _connect(self) -> socket.socket:
         s = socket.create_connection(
@@ -522,10 +713,17 @@ class RpcClient:
             except (ConnectionError, OSError):
                 self.close()
                 raise
-        rid, status, payload = resp
+        rid, status, payload, *extra = resp
         if rid != req_id:
             self.close()
             raise ConnectionError(f"rpc response id mismatch: {rid} != {req_id}")
+        # Record the state stamp BEFORE raising: an error reply (e.g. an
+        # ObserverStaleError bounce) still advances the txid high-water.
+        if extra and isinstance(extra[0], dict):
+            self.last_state = extra[0]
+            txid = extra[0].get("txid")
+            if isinstance(txid, int) and txid > self.last_seen_txid:
+                self.last_seen_txid = txid
         if status != 0:
             raise RpcError(payload["error"], payload["message"])
         return payload

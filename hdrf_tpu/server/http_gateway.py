@@ -516,7 +516,33 @@ class HttpGateway:
                 "qos_sheds_total": cluster.get("qos_sheds_total", 0),
                 "garbage_bytes": cluster.get("garbage_bytes", 0),
                 "scrub_repairs_triggered":
-                    cluster.get("scrub_repairs_triggered", 0)}
+                    cluster.get("scrub_repairs_triggered", 0),
+                # observer plane (ISSUE 20): one row per configured NN —
+                # role, applied txid and tail lag, so a dashboard sees the
+                # read replicas and their staleness without namespace access
+                "namenodes": self._namenode_rows()}
+
+    def _namenode_rows(self) -> list[dict]:
+        """Per-NN role/txid/lag rows for ``/health`` via each endpoint's
+        ``ha_state`` (the haadmin -haStatus analog; unreachable NNs get a
+        ``reachable: False`` row rather than poisoning the probe)."""
+        from hdrf_tpu.proto.rpc import RpcClient, normalize_addrs
+
+        rows = []
+        for addr in normalize_addrs(self._nn_addr):
+            try:
+                with RpcClient(addr, timeout=2.0) as c:
+                    st = c.call("ha_state")
+            except (OSError, ConnectionError):
+                rows.append({"addr": f"{addr[0]}:{addr[1]}",
+                             "reachable": False})
+                continue
+            rows.append({"addr": f"{addr[0]}:{addr[1]}", "reachable": True,
+                         "role": st.get("role"),
+                         "applied_txid": st.get("applied_txid",
+                                                st.get("seq", 0)),
+                         "lag_s": st.get("lag_s", 0.0)})
+        return rows
 
     def fsck(self) -> dict:
         """Gateway face of the NN invariant census (``rpc_fsck``): runs the

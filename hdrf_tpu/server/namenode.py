@@ -16,6 +16,10 @@ into one clean daemon with the same responsibilities:
 - heartbeat-driven command delivery: replicate / invalidate
   (DNA_TRANSFER / DNA_INVALIDATE, §3.5 of SURVEY.md)
 - durability via EditLog + fsimage (server/editlog.py)
+- observer read plane: a third role serving the read-only RPC set with a
+  bounded-staleness guarantee and an msync barrier — the
+  ObserverReadProxyProvider.java:60 / GlobalStateIdContext.java:40 state-id
+  protocol re-expressed on the msgpack reply envelope
 
 Locking: one namesystem lock (the reference's FSNamesystem global lock) —
 correct first, sharded later if metadata ops ever become the bottleneck.
@@ -262,10 +266,45 @@ class StandbyError(Exception):
     HA client proxy fails over to the next NN on this."""
 
 
+class ObserverStaleError(Exception):
+    """Observer read refused because the tailer hasn't caught up — either
+    to the caller's piggybacked state-id within ``observer_wait_s``, or at
+    all within the hard ``observer_max_lag_s`` staleness bound
+    (ObserverRetryOnActiveException analog).  The HA client proxy bounces
+    the read to the active on this; it is counted, never silently stale."""
+
+
+# The read-only RPC set an observer serves (ClientProtocol methods marked
+# @ReadOnly in the reference; GlobalStateIdContext.isCoordinatedCall
+# analog).  Everything else — mutations, admin transitions — is refused
+# with StandbyError unless it is DN-protocol/HA plumbing (_AUTH_EXEMPT):
+# observers consume DN registrations/heartbeats/block reports so their
+# block map stays warm enough to answer get_block_locations.
+_OBSERVER_READS = frozenset({
+    "get_block_locations", "stat", "listing", "ec_status",
+    "content_summary", "get_xattrs", "get_acl", "get_storage_policy",
+    "list_snapshots", "snapshot_diff", "list_cache_pools",
+    "list_cache_directives", "list_encryption_zones", "get_ez",
+    "datanode_report", "cluster_status", "decommission_status",
+    "slow_nodes_report", "slow_peers", "policy_violations",
+    "datanode_blocks", "get_events", "fsck", "metrics", "contention",
+    "flight_timeseries", "flight_query", "trace_spans",
+    "check_delegation_token", "msync", "ha_state",
+})
+
+
 class NameNode:
     def __init__(self, config: NameNodeConfig | None = None):
         self.config = config or NameNodeConfig()
-        self.role = self.config.role  # "active" | "standby"
+        self.role = self.config.role  # "active" | "standby" | "observer"
+        # Observer staleness bookkeeping: monotonic time of the last
+        # successful tail pass (lag_s = now - this on non-active roles)
+        # and the highest client state-id ever presented — the demand-side
+        # txid horizon that observer_lag_txids is measured against (an
+        # observer can't cheaply see the journal end, but it knows what
+        # clients have proven to exist).
+        self._tail_ok_t = time.monotonic()
+        self._max_seen_sid = 0
         # The FSNamesystem lock analog — instrumented (utils/lockprof.py):
         # per-RPC-method wait/hold books, saturation, long-hold stacks.
         self._lock = lockprof.InstrumentedRLock(
@@ -470,11 +509,11 @@ class NameNode:
         snap = self._editlog.load_image()
         if snap is not None:
             self._restore(snap)
-        if self.role == "standby":
+        if self.role != "active":
             from hdrf_tpu.server.editlog import JournalGapError
 
-            # tail-only: never truncate or append to the active's journal,
-            # and never apply past the quorum's committed floor
+            # standby/observer: tail-only — never truncate or append to the
+            # active's journal, and never apply past the committed floor
             try:
                 self._editlog.replay(self._apply_tolerant, readonly=True)
             except JournalGapError:
@@ -3332,6 +3371,13 @@ class NameNode:
         sample["nn_lock_wait_p99_us"] = self._lock.wait_p99_us()
         for m, p99 in self._lock.top_methods(3):
             sample[f"nn_lock_hold_p99_us|method={m}"] = p99
+        # Observer staleness (design decision 19): how far this replica's
+        # applied txid trails the demand horizon, in seconds and txids —
+        # the curve slo_report regresses on (REGRESS_UP observer_lag_s).
+        if self.role != "active":
+            sample["observer_lag_s"] = round(self._tail_lag_s(), 3)
+            sample["observer_lag_txids"] = max(
+                0, self._max_seen_sid - self._editlog.seq)
         return sample
 
     def rpc_flight_timeseries(self) -> dict:
@@ -3722,7 +3768,98 @@ class NameNode:
 
     def rpc_ha_state(self) -> dict:
         return {"role": self.role, "seq": self._editlog.seq,
+                "applied_txid": self._editlog.seq,
+                "lag_s": round(self._tail_lag_s(), 3),
                 "epoch": self._editlog.read_epoch()}
+
+    # ------------------------------------------------- observer read plane
+
+    # Wire methods an observer accepts besides _OBSERVER_READS: the DN
+    # protocol (registrations/heartbeats/reports keep the observer's soft
+    # block map warm — location sets are never journaled, so an observer
+    # that refused reports could not serve get_block_locations) and HA
+    # plumbing (transition_to_active is accepted here and refused in the
+    # handler so the caller gets a typed error, not a silent bounce).
+    _OBSERVER_PLUMBING = frozenset({
+        "register_datanode", "heartbeat", "lifeline", "block_report",
+        "incremental_block_report", "bad_block", "block_received",
+        "commit_block_sync", "stripe_complete", "bad_stripe",
+        "transition_to_active", "fetch_image",
+    })
+
+    def _tail_lag_s(self) -> float:
+        """Seconds since the last successful tail pass (0 on the active —
+        it IS the journal head)."""
+        if self.role == "active":
+            return 0.0
+        return max(0.0, time.monotonic() - self._tail_ok_t)
+
+    def _rpc_state_id(self) -> dict:
+        """Reply-envelope state stamp (GlobalStateIdContext analog): the
+        RPC server appends this to EVERY reply, so mutations on the active
+        piggyback the journal txid and observer replies carry applied_txid
+        + tail lag for the client's read-your-writes bookkeeping."""
+        return {"txid": self._editlog.seq, "role": self.role,
+                "lag_s": round(self._tail_lag_s(), 3)}
+
+    def _rpc_observer_gate(self, method: str, sid: int | None) -> None:
+        """Called by RpcServer before dispatching wire calls.  On an
+        observer: refuse non-read methods (StandbyError → HA proxy fails
+        over), wait out a bounded window for the tailer to reach the
+        caller's state-id, and enforce the hard staleness bound
+        (ObserverReadProxyProvider.isRead + ObserverRetryOnActive analog).
+        Active/standby roles pass everything through unchanged."""
+        if self.role != "observer":
+            return
+        if method not in _OBSERVER_READS:
+            if method in self._OBSERVER_PLUMBING:
+                return
+            raise StandbyError("observer namenode serves reads only")
+        if method in ("msync", "ha_state"):
+            return  # barrier/probe calls report staleness, never refuse
+        want = int(sid) if sid else 0
+        if want > self._max_seen_sid:
+            self._max_seen_sid = want
+        if want > self._editlog.seq:
+            deadline = time.monotonic() + self.config.observer_wait_s
+            pause = min(0.005, self.config.tail_interval_s)
+            while (self._editlog.seq < want
+                   and time.monotonic() < deadline):
+                time.sleep(pause)
+            if self._editlog.seq < want:
+                _M.incr("observer_stale_bounced")
+                raise ObserverStaleError(
+                    f"observer applied txid {self._editlog.seq} < client "
+                    f"state-id {want} after {self.config.observer_wait_s}s")
+        lag = self._tail_lag_s()
+        if lag > self.config.observer_max_lag_s:
+            _M.incr("observer_stale_bounced")
+            raise ObserverStaleError(
+                f"observer tail lag {lag:.2f}s exceeds the "
+                f"{self.config.observer_max_lag_s}s staleness bound")
+        _M.incr("observer_reads")
+
+    def rpc_msync(self, txid: int = 0, wait_s: float | None = None) -> dict:
+        """Consistency barrier (HAServiceProtocol msync analog): block —
+        deadline-bounded — until this NN has applied ``txid``, then report
+        where it stands.  On the active this returns immediately (it is
+        the txid source); a caller that msyncs every observer with its
+        last-seen txid gets read-your-writes on all subsequent observer
+        reads."""
+        _M.incr("msync_calls")
+        want = int(txid or 0)
+        if want > self._max_seen_sid:
+            self._max_seen_sid = want
+        budget = (self.config.observer_msync_wait_s if wait_s is None
+                  else float(wait_s))
+        deadline = time.monotonic() + max(0.0, budget)
+        while (self.role != "active" and self._editlog.seq < want
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        applied = self._editlog.seq
+        return {"applied_txid": applied, "role": self.role,
+                "caught_up": bool(self.role == "active" or applied >= want),
+                "lag_s": round(self._tail_lag_s(), 3)}
 
     # ------------------------------------------------- delegation tokens
 
@@ -3734,7 +3871,7 @@ class NameNode:
     _AUTH_EXEMPT = frozenset({
         "register_datanode", "heartbeat", "lifeline", "block_report",
         "incremental_block_report", "bad_block", "block_received",
-        "commit_block_sync", "ha_state", "transition_to_active",
+        "commit_block_sync", "ha_state", "msync", "transition_to_active",
         "fetch_image", "get_delegation_token", "renew_delegation_token",
         "cancel_delegation_token", "check_delegation_token",
     })
@@ -3822,10 +3959,14 @@ class NameNode:
     def rpc_transition_to_active(self) -> bool:
         """Manual/controller-driven failover (transitionToActive analog):
         final catch-up tail, claim the journal epoch (fencing the old
-        active), open for append, start the redundancy monitor."""
+        active), open for append, start the redundancy monitor.  Observers
+        are read replicas by contract, never failover candidates — the
+        refusal is typed so a misconfigured controller learns why."""
         with self._lock:
             if self.role == "active":
                 return True
+            if self.config.role == "observer":
+                raise ValueError("observer namenode cannot be promoted")
             # claim FIRST (fencing the old writer), THEN the final tail —
             # the reverse order loses any edit the not-yet-fenced active
             # appends between the tail and the claim, and reuses its seq.
@@ -3864,21 +4005,29 @@ class NameNode:
         return True
 
     def _tailer_loop(self) -> None:
-        """Standby: periodically replay the shared journal
-        (EditLogTailer.java:74 + StandbyCheckpointer roles)."""
+        """Standby/observer: periodically replay the shared journal
+        (EditLogTailer.java:74 + StandbyCheckpointer roles).  On an
+        observer each pass also refreshes the staleness gauges the read
+        gate and flight recorder report against."""
         from hdrf_tpu.server.editlog import JournalGapError
 
         interval = self.config.tail_interval_s
         quorum = bool(self.config.journal_addrs)
         applied_since_image = 0
         while not self._monitor_stop.wait(interval):
-            if self.role != "standby":
+            if self.role == "active":
                 return  # transitioned; monitor thread has taken over
             try:
+                fault_injection.point("namenode.tail", role=self.role)
                 with self._lock:
                     n = self._editlog.tail(self._apply_tolerant,
                                            reload_fn=self._reload_image)
                     self._drain_pending_ibr()
+                self._tail_ok_t = time.monotonic()
+                if self.role == "observer":
+                    _M.gauge("observer_lag_s", round(self._tail_lag_s(), 3))
+                    _M.gauge("observer_lag_txids",
+                             max(0, self._max_seen_sid - self._editlog.seq))
                 applied_since_image += n
                 if quorum and applied_since_image >= \
                         self.config.editlog_checkpoint_every:

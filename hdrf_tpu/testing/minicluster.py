@@ -5,6 +5,13 @@ Equivalent of the reference's MiniDFSCluster (MiniDFSCluster.java:141,
 per-node data dirs and ephemeral ports, plus restart/kill APIs for failure
 testing (restartDataNode/stopDataNode analogs).  Fast config defaults (small
 blocks, sub-second heartbeats) keep tests snappy.
+
+``observers=N`` boots N observer NNs per nameservice (read replicas with
+bounded staleness, ObserverReadProxyProvider analog) whose addrs join
+``nn_addrs()`` — DNs then heartbeat/report to them, keeping their block
+maps warm.  ``kill_namenode()``/``restart_namenode()`` mirror the worker
+kill/restart knobs, so failover tests and the metadata-storm harness share
+one deterministic path.
 """
 
 from __future__ import annotations
@@ -25,6 +32,7 @@ class MiniCluster:
                  replication: int = 3, block_size: int = 1 << 20,
                  container_size: int = 1 << 22, heartbeat_s: float = 0.2,
                  dead_node_s: float = 1.5, ha: bool = False,
+                 observers: int = 0,
                  journal_nodes: int = 0, secure: bool = False,
                  storage_types: list[str] | None = None,
                  volume_types: list[str] | None = None,
@@ -74,6 +82,9 @@ class MiniCluster:
         self._heartbeat_s = heartbeat_s
         self.namenode: NameNode | None = None
         self.standby: NameNode | None = None  # MiniQJMHACluster analog
+        self.observers_n = observers
+        self.observers: list[NameNode] = []   # NS 0's observers
+        self._killed: list[NameNode] = []     # abruptly-dead NNs (teardown)
         # Federation (MiniDFSNNTopology analog): ``nameservices`` > 1
         # boots that many independent namespaces over the ONE DN set;
         # each entry of ``self.ns`` is {"active": NN, "standby": NN|None}
@@ -128,9 +139,27 @@ class MiniCluster:
                     # peers must be symmetric: after a failover the DEMOTED
                     # original needs the new active for image bootstrap too
                     active.config.peers = [list(standby.addr)]
-            self.ns.append({"active": active, "standby": standby})
+            observers = []
+            for oi in range(self.observers_n):
+                # a snappier tail than the standby default keeps observer
+                # staleness (and msync waits) sub-100ms in tests
+                ob_cfg = dataclasses.replace(
+                    cfg, role="observer", port=0,
+                    tail_interval_s=min(cfg.tail_interval_s, 0.05))
+                if self.n_journal:
+                    ob_cfg = dataclasses.replace(
+                        ob_cfg,
+                        meta_dir=os.path.join(self.base_dir,
+                                              f"name-obs{oi}-ns{nsi}"
+                                              if self.nameservices_n > 1
+                                              else f"name-obs{oi}"),
+                        peers=[list(active.addr)])
+                observers.append(NameNode(ob_cfg).start())
+            self.ns.append({"active": active, "standby": standby,
+                            "observers": observers})
         self.namenode = self.ns[0]["active"]
         self.standby = self.ns[0]["standby"]
+        self.observers = self.ns[0]["observers"]
         for i in range(self.n_datanodes):
             self.datanodes[i] = self._make_dn(i).start()
         self.wait_for_datanodes(self.n_datanodes)
@@ -140,12 +169,16 @@ class MiniCluster:
         self.journalnodes[i].stop()
 
     def nn_addrs(self, nsi: int = 0) -> list:
-        """Addrs of ONE nameservice's NNs (active first)."""
+        """Addrs of ONE nameservice's NNs (active first, then standby,
+        then observers — DNs report to all of them; the HA client proxy
+        discovers each endpoint's role itself)."""
         ns = self.ns[nsi] if self.ns else {"active": self.namenode,
-                                           "standby": self.standby}
-        addrs = [ns["active"].addr]
+                                           "standby": self.standby,
+                                           "observers": self.observers}
+        addrs = [ns["active"].addr] if ns["active"] is not None else []
         if ns["standby"] is not None:
             addrs.append(ns["standby"].addr)
+        addrs.extend(o.addr for o in ns.get("observers", []))
         return addrs
 
     def all_ns_addrs(self) -> list:
@@ -195,15 +228,24 @@ class MiniCluster:
                 dn.stop()
         stopped = set()
         for ns in self.ns:
-            for role in ("standby", "active"):
-                nn = ns[role]
+            for nn in [ns["standby"], ns["active"],
+                       *ns.get("observers", [])]:
                 if nn is not None and id(nn) not in stopped:
                     stopped.add(id(nn))
                     nn.stop()
-        for nn in (self.standby, self.namenode):
+        for nn in (self.standby, self.namenode, *self.observers):
             if nn is not None and id(nn) not in stopped:
                 stopped.add(id(nn))
                 nn.stop()
+        for nn in self._killed:
+            # finish tearing down abruptly-killed NNs (their RPC server is
+            # already severed; stop() is idempotent for the rest)
+            if id(nn) not in stopped:
+                stopped.add(id(nn))
+                try:
+                    nn.stop()
+                except Exception:  # noqa: BLE001 — already half-dead
+                    pass
         for jn in self.journalnodes:
             try:
                 jn.stop()
@@ -289,20 +331,41 @@ class MiniCluster:
                 dn.config.reduction.worker_addr = list(self._worker_addr)
         return tuple(self._worker_addr)
 
+    def kill_namenode(self, nsi: int = 0) -> None:
+        """Abrupt active-NN death (the kill_datanode/kill_worker idiom for
+        the metadata plane): sever the RPC server so clients, DNs and the
+        FailoverController all see a dead endpoint — no clean editlog
+        close, no role handoff.  Promotion is the controller's job; full
+        teardown of the corpse happens at cluster stop()."""
+        ns = self.ns[nsi]
+        nn = ns["active"]
+        assert nn is not None, "active namenode already dead"
+        nn._monitor_stop.set()
+        nn._rpc.stop()
+        self._killed.append(nn)
+        ns["active"] = None
+        if nsi == 0:
+            self.namenode = None
+
     def restart_namenode(self) -> NameNode:
         """Stop + boot the NameNode over the same meta dir AND the same port
-        (so running DNs/clients reconnect) — exercises fsimage+edits recovery."""
+        (so running DNs/clients reconnect) — exercises fsimage+edits recovery.
+        After kill_namenode() this reboots the corpse's config; if a
+        controller promoted a standby meanwhile, the reboot comes back,
+        claims the next epoch on transition only — here it restarts as
+        active and the journal-epoch fencing settles who wins."""
         import dataclasses
 
-        port = self.namenode.addr[1]
+        prev = self.namenode if self.namenode is not None else self._killed[-1]
+        port = prev.addr[1]
         # the RUNNING NN's config, not the base template: with federation
         # ns0's meta_dir/identity were set by dataclasses.replace at start
         # role is forced active: a promoted ex-standby's CONFIG still says
         # standby (transition_to_active flips the runtime role only), and
         # restarting it as a standby would leave the cluster activeless
-        cfg = dataclasses.replace(self.namenode.config, port=port,
-                                  role="active")
-        self.namenode.stop()
+        cfg = dataclasses.replace(prev.config, port=port, role="active")
+        if self.namenode is not None:
+            self.namenode.stop()
         self.namenode = NameNode(cfg).start()
         if self.ns:
             self.ns[0]["active"] = self.namenode

@@ -244,7 +244,8 @@ def main(argv: list[str] | None = None) -> int:
                     root, key="longhorizon.storage_ratio_slope")
                 + check_bench_contract(root, key="nn")
                 + check_bench_contract(root, key="nn.rpc_p99_ms")
-                + check_bench_contract(root, key="nn.lock_saturation"))
+                + check_bench_contract(root, key="nn.lock_saturation")
+                + check_bench_contract(root, key="nn.observer_share"))
     for p in problems:
         print(p)
     print(f"{len(problems)} violation(s)" if problems

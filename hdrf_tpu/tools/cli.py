@@ -17,7 +17,7 @@ dispatch; DFSAdmin.java:441, OfflineImageViewer / OfflineEditsViewer under
                            -movblock -setBalancerBandwidth -provide
                            -allowSnapshot -setQuota -setSpaceQuota -clrQuota
                            -safemode -decommission -decommissionStatus
-                           -haState -transitionToActive
+                           -haState -haStatus -transitionToActive
   oiv / oev                offline fsimage / edit-log viewers
   balancer                 spread replicas toward the mean DN utilization
 """
@@ -383,6 +383,21 @@ def cmd_dfsadmin(args) -> int:
                     with RpcClient((host, int(port)), timeout=3.0) as rc:
                         st = rc.call("ha_state")
                     print(f"{a}: {st['role']} seq={st['seq']} epoch={st['epoch']}")
+                except (OSError, ConnectionError):
+                    print(f"{a}: unreachable")
+        elif args.op == "-haStatus":
+            # observer-aware -haState (ISSUE 20; haadmin -getAllServiceState
+            # analog): role + applied txid + tail lag per endpoint
+            from hdrf_tpu.proto.rpc import RpcClient
+            for a in args.args or [args.namenode]:
+                host, port = a.rsplit(":", 1)
+                try:
+                    with RpcClient((host, int(port)), timeout=3.0) as rc:
+                        st = rc.call("ha_state")
+                    print(f"{a}: {st['role']} "
+                          f"applied_txid={st.get('applied_txid', st['seq'])} "
+                          f"lag_s={st.get('lag_s', 0.0)} "
+                          f"epoch={st['epoch']}")
                 except (OSError, ConnectionError):
                     print(f"{a}: unreachable")
         elif args.op == "-transitionToActive":

@@ -65,7 +65,8 @@ REGRESS_UP = ("read_p95_ms", "write_p95_ms", "stalls", "breakers_open",
               # contention observatory (ISSUE 18): namesystem-lock
               # saturation and rolling acquire-wait tail — the leading
               # indicators of a lock convoy, both one-directional
-              "nn_lock_saturation", "nn_lock_wait_p99_us")
+              "nn_lock_saturation", "nn_lock_wait_p99_us",
+              "observer_lag_s")
 REGRESS_DOWN = ("container_cache_hit_ratio", "cache_hit_ratio",
                 "dedup_ratio", "datanodes_live")
 # Relative drift below this never flags (jitter floor), and a baseline of

@@ -96,6 +96,17 @@ class TestHa:
 
 
 class TestFailoverController:
+    def test_choose_candidate_highest_txid_never_observer(self):
+        from hdrf_tpu.server.failover import FailoverController
+
+        a1, a2, a3 = ("h", 1), ("h", 2), ("h", 3)
+        states = [(a1, "standby", 5), (a2, "standby", 9),
+                  (a3, "observer", 50)]
+        assert FailoverController._choose_candidate(states) == a2
+        # only observers reachable: nobody to promote
+        assert FailoverController._choose_candidate(
+            [(a3, "observer", 50)]) is None
+
     def test_auto_failover_on_active_death(self, ha_cluster):
         from hdrf_tpu.server.failover import FailoverController
 
@@ -114,6 +125,190 @@ class TestFailoverController:
                 assert c.read("/ha/k") == b"m" * 10_000
         finally:
             fc.stop()
+
+
+@pytest.fixture
+def obs_cluster():
+    with MiniCluster(n_datanodes=3, replication=2, ha=True,
+                     observers=1) as mc:
+        yield mc
+
+
+def _ha_counter(key: str) -> int:
+    from hdrf_tpu.utils import metrics
+
+    return metrics.registry("client.ha").snapshot()["counters"].get(key, 0)
+
+
+class TestObserver:
+    """Observer read plane (ISSUE 20): staleness-bounded read replicas,
+    msync read-your-writes, breaker demotion, storm-proof failover — the
+    ObserverReadProxyProvider / GlobalStateIdContext contract."""
+
+    def test_observer_serves_reads_refuses_mutations(self, obs_cluster):
+        ob = obs_cluster.observers[0]
+        with obs_cluster.client("obs0") as c:
+            c.mkdir("/obs/d")
+            c.msync(wait_s=5.0)
+        assert ob.rpc_ha_state()["role"] == "observer"
+        with RpcClient(ob.addr) as oc:
+            from hdrf_tpu.proto.rpc import RpcError
+
+            assert oc.call("stat", path="/obs/d")["type"] == "dir"
+            with pytest.raises(RpcError, match="Standby"):
+                oc.call("mkdir", path="/obs/nope")
+            # and an observer can never be promoted (satellite 1)
+            with pytest.raises(RpcError, match="observer"):
+                oc.call("transition_to_active")
+
+    def test_read_your_writes_after_every_mutation_type(self, obs_cluster):
+        """The msync matrix: after each mutating RPC type the very next
+        observer-routed read must see the write — zero-tolerance on
+        silent staleness."""
+        reads0 = _ha_counter("observer_reads")
+        with obs_cluster.client("obs1") as c:
+            c.mkdir("/obs/m")
+            c.msync(wait_s=5.0)
+            assert c.stat("/obs/m")["type"] == "dir"
+
+            c.write("/obs/m/f", b"v1" * 4096)          # create+addBlock+complete
+            c.msync(wait_s=5.0)
+            assert c.stat("/obs/m/f")["length"] == 8192
+            assert c.read("/obs/m/f") == b"v1" * 4096
+
+            c.rename("/obs/m/f", "/obs/m/g")           # rename
+            c.msync(wait_s=5.0)
+            assert c.exists("/obs/m/g") and not c.exists("/obs/m/f")
+
+            c.setfattr("/obs/m/g", "user.tag", b"t1")  # set_xattr
+            c.msync(wait_s=5.0)
+            assert c.getfattr("/obs/m/g")["user.tag"] == b"t1"
+
+            c.set_replication("/obs/m/g", 3)           # setrep
+            c.msync(wait_s=5.0)
+            assert c.stat("/obs/m/g")["replication"] == 3
+
+            c.delete("/obs/m/g")                       # delete
+            c.msync(wait_s=5.0)
+            assert not c.exists("/obs/m/g")
+        # the matrix's reads were actually observer-served, not active
+        assert _ha_counter("observer_reads") > reads0
+
+    def test_stale_observer_bounces_not_lies(self, obs_cluster):
+        """Park the observer's tailer (tail fault point), mutate, read:
+        the observer cannot reach the client's txid inside the wait
+        window, refuses with ObserverStaleError, and the proxy bounces
+        the read to the active — correct answer, bounce counted."""
+        from hdrf_tpu.utils import fault_injection, metrics
+
+        def park(role=None, **kw):
+            if role == "observer":
+                raise RuntimeError("tailer parked by test")
+
+        bounces0 = _ha_counter("observer_bounces")
+        nn_stale0 = metrics.registry("namenode").snapshot()[
+            "counters"].get("observer_stale_bounced", 0)
+        with fault_injection.inject("namenode.tail", park):
+            with obs_cluster.client("obs2") as c:
+                c.mkdir("/obs/stale")
+                assert c.stat("/obs/stale")["type"] == "dir"  # bounced, not stale
+        assert _ha_counter("observer_bounces") > bounces0
+        assert metrics.registry("namenode").snapshot()["counters"].get(
+            "observer_stale_bounced", 0) > nn_stale0
+
+    def test_breaker_demotes_dead_observer(self, obs_cluster):
+        from hdrf_tpu.utils import retry
+
+        ob = obs_cluster.observers[0]
+        host, port = ob.addr
+        with obs_cluster.client("obs3") as c:
+            c.write("/obs/b", b"alive" * 1000)
+            c.msync(wait_s=5.0)
+            assert c.read("/obs/b") == b"alive" * 1000
+            ob.stop()  # observer dies; reads must keep succeeding
+            for _ in range(5):
+                assert c.stat("/obs/b")["length"] == 5000
+        b = retry.all_breakers().get(f"nn:{host}:{port}")
+        assert b is not None and b.state == "open"
+
+    def test_kill_active_mid_storm(self, obs_cluster):
+        """Active dies under reader load; the controller promotes the
+        standby while observer reads keep flowing — zero responses staler
+        than the bound (content mismatches) throughout the window."""
+        import threading
+
+        from hdrf_tpu.server.failover import FailoverController
+
+        payload = b"storm" * 2000
+        with obs_cluster.client("seed") as c:
+            c.write("/obs/storm", payload)
+            c.msync(wait_s=5.0)
+        fc = FailoverController(obs_cluster.nn_addrs(),
+                                probe_interval_s=0.2, grace=2).start()
+        stop = threading.Event()
+        reads, errors, stale = [0], [0], [0]
+
+        def reader():
+            with obs_cluster.client("storm-reader") as c:
+                while not stop.is_set():
+                    try:
+                        data = c.read("/obs/storm")
+                    except Exception:  # noqa: BLE001 — counted, judged below
+                        errors[0] += 1
+                        time.sleep(0.05)
+                        continue
+                    reads[0] += 1
+                    if data != payload:
+                        stale[0] += 1
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            time.sleep(1.0)
+            pre_kill = reads[0]
+            obs_cluster.kill_namenode()
+            _wait(lambda: obs_cluster.standby is not None
+                  and obs_cluster.standby.role == "active",
+                  timeout=15, msg="auto promotion")
+            obs_cluster.ns[0]["active"] = obs_cluster.standby
+            obs_cluster.namenode = obs_cluster.standby
+            obs_cluster.ns[0]["standby"] = None
+            obs_cluster.standby = None
+            time.sleep(1.0)
+        finally:
+            stop.set()
+            t.join()
+            fc.stop()
+        assert stale[0] == 0, "stale-beyond-bound responses"
+        assert reads[0] > pre_kill, "reads stopped at the kill"
+        with obs_cluster.client("post") as c:
+            c.write("/obs/after", b"promoted")
+            c.msync(wait_s=5.0)
+            assert c.read("/obs/after") == b"promoted"
+
+    def test_metadata_cache_invalidated_on_txid_bump(self, obs_cluster):
+        from hdrf_tpu.client.filesystem import HdrfClient
+        from hdrf_tpu.config import ClientConfig
+        from hdrf_tpu.utils import metrics
+
+        def hits():
+            return metrics.registry("client").snapshot()[
+                "counters"].get("meta_cache_hits", 0)
+
+        cfg = ClientConfig(metadata_cache_ttl_s=30.0)
+        with HdrfClient(obs_cluster.nn_addrs(), name="cache",
+                        config=cfg) as c:
+            c.mkdir("/obs/cache")
+            c.msync(wait_s=5.0)
+            c.stat("/obs/cache")
+            h0 = hits()
+            c.stat("/obs/cache")            # same generation: served hot
+            assert hits() == h0 + 1
+            c.mkdir("/obs/cache2")          # txid bump invalidates the gen
+            c.msync(wait_s=5.0)
+            h1 = hits()
+            c.stat("/obs/cache")
+            assert hits() == h1             # miss: generation moved
 
 
 class TestJournalTornTail:

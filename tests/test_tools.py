@@ -264,6 +264,36 @@ class TestChurnHarness:
         assert o["verdict"] == "REGRESSED"
 
 
+class TestObserverAbHarness:
+    def test_observer_ab_one_json_line(self):
+        """`benchmarks nn --observer-ab` contract (ISSUE 20): EXACTLY one
+        JSON line with paired a/b legs and the observer-plane keys; the
+        observer leg must actually route reads off the active."""
+        from hdrf_tpu import benchmarks
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert benchmarks.main(
+                ["nn", "--observer-ab", "--ops", "40", "--clients", "2",
+                 "--meta-per-op", "2", "--rounds", "1"]) == 0
+        lines = buf.getvalue().splitlines()
+        assert len(lines) == 1
+        o = json.loads(lines[0])
+        assert o["bench"] == "nn_observer_ab"
+        for leg in ("a", "b"):
+            assert {"read_p99_ms", "active_read_lock_share",
+                    "ops_per_s"} <= set(o[leg])
+        for key in ("observer_reads", "observer_share", "msync_p99_ms",
+                    "observer_lag_txids"):
+            assert key in o
+        assert o["errors"] == 0
+        assert o["observer_reads"] > 0
+        # the tentpole's acceptance bar: observers drain the active's
+        # read-method lock share
+        assert o["b"]["active_read_lock_share"] \
+            <= o["a"]["active_read_lock_share"]
+
+
 class TestOfflineViewers:
     def test_oiv_oev(self, cluster, tmp_path):
         nn = nn_arg(cluster)
